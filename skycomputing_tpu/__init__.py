@@ -1,0 +1,32 @@
+"""SkyComputing-TPU: load-balanced pipeline-parallel training, TPU-native.
+
+A from-scratch JAX/XLA framework with the capabilities of
+hpcaitech/SkyComputing (reference mounted at ``/root/reference``): per-device
+and per-layer profiling, MIP/greedy/even layer->device allocation, and
+pipeline-parallel BERT training — re-designed for TPU (single-controller JAX,
+jit-compiled stages, ICI transfers, bfloat16 MXU compute) instead of
+torch.distributed RPC over a GPU cluster.
+"""
+
+__version__ = "0.1.0"
+
+from .config import Config, load_config
+from .registry import DATA_GENERATOR, DATASET, HOOKS, LAYER, LOSS, MODEL, Registry
+from .utils import Logger, DistributedTimer, get_time, generate_worker_name
+
+__all__ = [
+    "Config",
+    "load_config",
+    "Registry",
+    "LAYER",
+    "DATASET",
+    "HOOKS",
+    "DATA_GENERATOR",
+    "MODEL",
+    "LOSS",
+    "Logger",
+    "DistributedTimer",
+    "get_time",
+    "generate_worker_name",
+    "__version__",
+]

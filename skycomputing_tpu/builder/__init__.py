@@ -1,0 +1,77 @@
+"""Config-driven factories (reference: ``scaelum/builder/builder.py:12-49``).
+
+``build_module_from_cfg`` composes: layer-config list -> ``build_layer`` each
+-> ``LayerStack``.  The reference additionally wraps the stack in a
+``ModuleWrapper`` carrying per-worker runtime knobs; in the TPU build those
+knobs (device binding, slowdown, memory limit) belong to the pipeline stage
+runtime (``skycomputing_tpu.parallel.pipeline.StageRuntime``), keeping model
+construction pure.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..registry import DATA_GENERATOR, HOOKS, LAYER
+from .layer_stack import LayerStack, as_tuple
+from . import proxy_layers  # noqa: F401 - registers Conv2d / MatmulStack
+
+
+def build_layer(layer_type: str, **kwargs):
+    """Instantiate one registered layer module from its config kwargs."""
+    cls = LAYER.get_module(layer_type)
+    return cls(**kwargs)
+
+
+def build_hook(cfg: Dict):
+    cfg = dict(cfg)
+    hook_type = cfg.pop("type")
+    return HOOKS.get_module(hook_type)(**cfg)
+
+
+def build_data_generator(generator_type: str, generator_cfg: Dict):
+    return DATA_GENERATOR.get_module(generator_type)(**generator_cfg)
+
+
+def build_layer_stack(model_cfg: Sequence[Dict]) -> LayerStack:
+    """Layer-config list -> LayerStack of instantiated modules."""
+    modules = []
+    for layer_cfg in model_cfg:
+        cfg = dict(layer_cfg)
+        layer_type = cfg.pop("layer_type")
+        modules.append(build_layer(layer_type, **cfg))
+    return LayerStack(modules)
+
+
+# Reference-name alias: build_module_from_cfg built the worker-side stage
+# module (``builder/builder.py:29-41``); rank/wrapper args are accepted and
+# ignored for signature compatibility.
+def build_module_from_cfg(
+    model_cfg: Sequence[Dict],
+    rank: Optional[int] = None,
+    module_wrapper_cfg: Optional[Dict] = None,
+) -> LayerStack:
+    return build_layer_stack(model_cfg)
+
+
+def build_dataloader_from_cfg(data_cfg: Dict):
+    """Dataset cfg + dataloader cfg -> DataLoader (see dataset package)."""
+    from ..dataset import DATASET, DataLoader  # local import to avoid cycle
+
+    dataset_cfg = dict(data_cfg["dataset_cfg"])
+    dataloader_cfg = dict(data_cfg.get("dataloader_cfg", {}))
+    ds_type = dataset_cfg.pop("type")
+    dataset = DATASET.get_module(ds_type)(**dataset_cfg)
+    return DataLoader(dataset, **dataloader_cfg)
+
+
+__all__ = [
+    "LayerStack",
+    "as_tuple",
+    "build_layer",
+    "build_hook",
+    "build_data_generator",
+    "build_layer_stack",
+    "build_module_from_cfg",
+    "build_dataloader_from_cfg",
+]

@@ -1,0 +1,77 @@
+"""Proxy layers for device benchmarking.
+
+The reference benchmarks device speed with a stack of torch ``Conv2d`` layers
+resolved through the registry's ``torch.nn`` fallback
+(``experiment/config.py:134-149``, ``registry/registry.py:20-24``).  Here the
+equivalents are registered flax modules:
+
+- ``Conv2d`` accepts torch-style NCHW inputs and ctor args so reference-shaped
+  proxy configs keep working;
+- ``MatmulStack`` is the TPU-native proxy — a chain of MXU-sized matmuls is a
+  far better predictor of TPU throughput than convs.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+from ..registry import LAYER
+
+
+@LAYER.register_module
+class Conv2d(nn.Module):
+    """Torch-signature 2D conv over NCHW inputs (proxy-model compatibility)."""
+
+    in_channels: int
+    out_channels: int
+    kernel_size: Union[int, Tuple[int, int]] = 3
+    padding: Union[int, Tuple[int, int]] = 0
+    stride: Union[int, Tuple[int, int]] = 1
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x):
+        ks = self.kernel_size
+        ks = (ks, ks) if isinstance(ks, int) else tuple(ks)
+        pad = self.padding
+        pad = (pad, pad) if isinstance(pad, int) else tuple(pad)
+        st = self.stride
+        st = (st, st) if isinstance(st, int) else tuple(st)
+
+        x = jnp.transpose(x, (0, 2, 3, 1))  # NCHW -> NHWC (TPU-native layout)
+        x = nn.Conv(
+            features=self.out_channels,
+            kernel_size=ks,
+            strides=st,
+            padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+            dtype=jnp.dtype(self.dtype),
+            param_dtype=jnp.float32,
+        )(x)
+        return jnp.transpose(x, (0, 3, 1, 2))  # back to NCHW for chaining
+
+
+@LAYER.register_module
+class MatmulStack(nn.Module):
+    """``depth`` chained square matmuls — an MXU-saturating speed proxy."""
+
+    features: int = 1024
+    depth: int = 4
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(jnp.dtype(self.dtype))
+        for i in range(self.depth):
+            x = nn.Dense(
+                self.features,
+                dtype=jnp.dtype(self.dtype),
+                param_dtype=jnp.float32,
+                name=f"mm_{i}",
+            )(x)
+        return x
+
+
+__all__ = ["Conv2d", "MatmulStack"]

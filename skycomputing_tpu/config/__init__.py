@@ -1,0 +1,86 @@
+"""Python-file-as-config system.
+
+Parity with the reference config layer
+(``/root/reference/scaelum/config/config.py:10-78``): a ``.py`` file is
+executed, its non-dunder / non-module / non-class globals are harvested into an
+attribute-dict ``Config``, with optional single-level ``base`` inheritance.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os.path as osp
+import sys
+from importlib.machinery import SourceFileLoader
+from typing import Any, Dict
+
+
+class Config(dict):
+    """Dict whose values are also reachable as attributes."""
+
+    def __missing__(self, name):
+        raise KeyError(name)
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self[name]
+        except KeyError as e:
+            raise AttributeError(name) from e
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        self[name] = value
+
+    def update(self, config: Dict) -> "Config":  # type: ignore[override]
+        for k, v in config.items():
+            self[k] = v
+        return self
+
+    @staticmethod
+    def from_dict(data: Dict) -> "Config":
+        cfg = Config()
+        cfg.update(data)
+        return cfg
+
+
+def _py_to_dict(py_path: str) -> Dict[str, Any]:
+    """Execute a python file and harvest its plain-value globals."""
+    if not py_path.endswith(".py"):
+        raise ValueError(f"config file must be a .py file, got {py_path!r}")
+
+    py_path = osp.abspath(py_path)
+    parent_dir = osp.dirname(py_path)
+    inserted = parent_dir not in sys.path
+    if inserted:
+        sys.path.insert(0, parent_dir)
+
+    module_name = "_skytpu_config_" + osp.splitext(osp.basename(py_path))[0]
+    try:
+        loader = SourceFileLoader(fullname=module_name, path=py_path)
+        module = loader.load_module()  # noqa: deprecated but dependency-free
+    finally:
+        if inserted:
+            sys.path.remove(parent_dir)
+
+    harvested = {
+        k: v
+        for k, v in vars(module).items()
+        if not k.startswith("__")
+        and not inspect.ismodule(v)
+        and not inspect.isclass(v)
+    }
+    sys.modules.pop(module_name, None)
+    return harvested
+
+
+def load_config(file_path: str) -> Config:
+    """Load a python config file, honoring a ``base = "other.py"`` field."""
+    config = Config.from_dict(_py_to_dict(file_path))
+    base = config.pop("base", None)
+    if base:
+        base_path = osp.join(osp.dirname(osp.abspath(file_path)), base)
+        base_config = Config.from_dict(_py_to_dict(base_path))
+        config = base_config.update(config)
+    return config
+
+
+__all__ = ["Config", "load_config"]

@@ -1,0 +1,25 @@
+from ..registry import DATASET
+from .bert_dataset import GlueDataset
+from .data_generator import (
+    BaseGenerator,
+    DataloaderGenerator,
+    RandomTensorGenerator,
+    RandomTokenGenerator,
+)
+from .dataloader import DataLoader
+from .datasets import RandomBertDataset, RandomImageDataset, RandomMlpDataset
+from . import glue
+
+__all__ = [
+    "DATASET",
+    "GlueDataset",
+    "BaseGenerator",
+    "DataloaderGenerator",
+    "RandomTensorGenerator",
+    "RandomTokenGenerator",
+    "DataLoader",
+    "RandomBertDataset",
+    "RandomImageDataset",
+    "RandomMlpDataset",
+    "glue",
+]

@@ -1,0 +1,79 @@
+"""Toy datasets (reference: ``scaelum/dataset/dataset.py:15-46``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..registry import DATASET
+
+
+@DATASET.register_module
+class RandomMlpDataset:
+    """Random-feature regression-style dataset for MLP smoke tests."""
+
+    def __init__(self, num_samples: int = 256, in_features: int = 32,
+                 num_classes: int = 4, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        self.features = rng.normal(size=(num_samples, in_features)).astype(np.float32)
+        self.labels = rng.integers(0, num_classes, size=(num_samples,))
+
+    def __len__(self):
+        return len(self.features)
+
+    def __getitem__(self, idx):
+        return (self.features[idx],), int(self.labels[idx])
+
+
+@DATASET.register_module
+class RandomImageDataset:
+    """CIFAR-shaped random images (offline stand-in for CIFAR10Dataset)."""
+
+    def __init__(self, num_samples: int = 256, shape=(3, 32, 32),
+                 num_classes: int = 10, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        self.images = rng.normal(size=(num_samples, *shape)).astype(np.float32)
+        self.labels = rng.integers(0, num_classes, size=(num_samples,))
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        return (self.images[idx],), int(self.labels[idx])
+
+
+@DATASET.register_module
+class RandomBertDataset:
+    """Synthetic MNLI-shaped rows: ((input_ids, mask, segment_ids), label).
+
+    Shape-identical to GlueDataset output (``dataset/bert_dataset.py:34-37``)
+    so the whole training path runs with zero downloads.
+    """
+
+    def __init__(self, num_samples: int = 512, max_seq_length: int = 128,
+                 vocab_size: int = 30522, num_classes: int = 3, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        self.input_ids = rng.integers(
+            5, vocab_size, size=(num_samples, max_seq_length), dtype=np.int32
+        )
+        lengths = rng.integers(8, max_seq_length + 1, size=(num_samples,))
+        self.input_mask = (
+            np.arange(max_seq_length)[None, :] < lengths[:, None]
+        ).astype(np.int32)
+        self.input_ids *= self.input_mask
+        seg_split = rng.integers(1, max_seq_length, size=(num_samples,))
+        self.segment_ids = (
+            np.arange(max_seq_length)[None, :] >= seg_split[:, None]
+        ).astype(np.int32) * self.input_mask
+        self.labels = rng.integers(0, num_classes, size=(num_samples,))
+
+    def __len__(self):
+        return len(self.input_ids)
+
+    def __getitem__(self, idx):
+        return (
+            (self.input_ids[idx], self.input_mask[idx], self.segment_ids[idx]),
+            int(self.labels[idx]),
+        )
+
+
+__all__ = ["RandomMlpDataset", "RandomImageDataset", "RandomBertDataset"]

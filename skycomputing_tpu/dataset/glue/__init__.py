@@ -1,0 +1,35 @@
+from .processor import (
+    PROCESSORS,
+    ColaProcessor,
+    DataProcessor,
+    InputExample,
+    InputFeatures,
+    MnliProcessor,
+    MrpcProcessor,
+    Sst2Processor,
+    convert_examples_to_features,
+)
+from .tokenization import (
+    BasicTokenizer,
+    BertTokenizer,
+    WordpieceTokenizer,
+    build_synthetic_vocab,
+    load_vocab,
+)
+
+__all__ = [
+    "PROCESSORS",
+    "ColaProcessor",
+    "DataProcessor",
+    "InputExample",
+    "InputFeatures",
+    "MnliProcessor",
+    "MrpcProcessor",
+    "Sst2Processor",
+    "convert_examples_to_features",
+    "BasicTokenizer",
+    "BertTokenizer",
+    "WordpieceTokenizer",
+    "build_synthetic_vocab",
+    "load_vocab",
+]

@@ -1,0 +1,26 @@
+from .bert_config import BertConfig, bert_config
+from .bert import (
+    ACT2FN,
+    BertEmbeddings,
+    BertLayer_Body,
+    BertLayer_Head,
+    BertLayer_Tail,
+    BertPooler,
+    BertSelfAttention,
+    BertTailForClassification,
+    bert_layer_configs,
+)
+
+__all__ = [
+    "BertConfig",
+    "bert_config",
+    "ACT2FN",
+    "BertEmbeddings",
+    "BertLayer_Body",
+    "BertLayer_Head",
+    "BertLayer_Tail",
+    "BertPooler",
+    "BertSelfAttention",
+    "BertTailForClassification",
+    "bert_layer_configs",
+]

@@ -1,0 +1,28 @@
+from .logger import Logger
+from .timer import DistributedTimer, PhaseTimer, get_time
+from .tree import (
+    abstract_bytes,
+    param_bytes,
+    param_count,
+    tree_device_put,
+    tree_to_host,
+)
+
+
+def generate_worker_name(rank: int) -> str:
+    """Reference naming scheme (``scaelum/utils.py:86-87``)."""
+    return f"worker{rank}"
+
+
+__all__ = [
+    "Logger",
+    "DistributedTimer",
+    "PhaseTimer",
+    "get_time",
+    "param_count",
+    "param_bytes",
+    "abstract_bytes",
+    "tree_device_put",
+    "tree_to_host",
+    "generate_worker_name",
+]

@@ -1,0 +1,87 @@
+"""Timing utilities.
+
+The reference needs a *file-based* ``DistributedTimer``
+(``scaelum/timer/timer.py:10-29``) because backward-phase timing spans RPC
+worker processes that only share a filesystem.  Under a single-controller JAX
+program there is exactly one host process, so the same API is served by an
+in-memory timestamp list; an optional ``root`` still mirrors timestamps to a
+file for log-compatibility with the reference's experiment layout.
+
+``get_time`` blocks on outstanding device work the way the reference's
+``utils.get_time`` calls ``torch.cuda.synchronize()``
+(``scaelum/utils.py:17-24``): pass the arrays you need finished.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional
+
+import jax
+
+
+def get_time(*sync_on) -> float:
+    """Wall-clock now, after blocking on any given JAX arrays."""
+    for x in sync_on:
+        jax.block_until_ready(x)
+    return time.perf_counter()
+
+
+class DistributedTimer:
+    """API-compatible timestamp exchange; in-memory, optionally file-mirrored."""
+
+    FILENAME = "dist_timer.txt"
+
+    def __init__(self, root: Optional[str] = None):
+        self._root = root
+        self._stamps: List[float] = []
+        if root is not None:
+            os.makedirs(root, exist_ok=True)
+
+    @property
+    def _file(self) -> Optional[str]:
+        if self._root is None:
+            return None
+        return os.path.join(self._root, self.FILENAME)
+
+    def add_timestamp(self) -> None:
+        stamp = time.perf_counter()
+        self._stamps.append(stamp)
+        if self._file is not None:
+            with open(self._file, "a") as fh:
+                fh.write(f"{stamp}\n")
+
+    def get_prev_interval(self) -> float:
+        if len(self._stamps) < 2:
+            return 0.0
+        return self._stamps[-1] - self._stamps[-2]
+
+    def clean(self) -> None:
+        self._stamps.clear()
+        f = self._file
+        if f is not None and os.path.exists(f):
+            os.remove(f)
+
+
+class PhaseTimer:
+    """Accumulates named phase durations (forward/backward/step/...)."""
+
+    def __init__(self):
+        self.totals = {}
+        self.counts = {}
+
+    def record(self, phase: str, seconds: float) -> None:
+        self.totals[phase] = self.totals.get(phase, 0.0) + seconds
+        self.counts[phase] = self.counts.get(phase, 0) + 1
+
+    def mean(self, phase: str) -> float:
+        if self.counts.get(phase, 0) == 0:
+            return 0.0
+        return self.totals[phase] / self.counts[phase]
+
+    def summary(self) -> dict:
+        return {k: self.mean(k) for k in self.totals}
+
+
+__all__ = ["get_time", "DistributedTimer", "PhaseTimer"]

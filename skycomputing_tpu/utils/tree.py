@@ -1,0 +1,49 @@
+"""Pytree helpers used across dynamics / parallel / runner layers."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def param_count(tree: Any) -> int:
+    """Total number of scalars in a pytree of arrays."""
+    return int(sum(np.prod(x.shape) for x in jax.tree_util.tree_leaves(tree)))
+
+
+def param_bytes(tree: Any) -> int:
+    """Total bytes of a pytree of arrays (by dtype itemsize)."""
+    total = 0
+    for x in jax.tree_util.tree_leaves(tree):
+        total += int(np.prod(x.shape)) * jnp.dtype(x.dtype).itemsize
+    return total
+
+
+def abstract_bytes(avals) -> int:
+    """Bytes of a pytree of ShapeDtypeStruct / abstract values."""
+    total = 0
+    for x in jax.tree_util.tree_leaves(avals):
+        total += int(np.prod(x.shape)) * jnp.dtype(x.dtype).itemsize
+    return total
+
+
+def tree_device_put(tree: Any, device) -> Any:
+    """Commit every leaf of a pytree to one device."""
+    return jax.device_put(tree, device)
+
+
+def tree_to_host(tree: Any) -> Any:
+    """Fetch a pytree to host numpy arrays."""
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
+__all__ = [
+    "param_count",
+    "param_bytes",
+    "abstract_bytes",
+    "tree_device_put",
+    "tree_to_host",
+]

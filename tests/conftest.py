@@ -1,0 +1,41 @@
+"""Test harness: force an 8-device CPU "cluster".
+
+The reference validated multi-node behavior on a real Slurm cluster; the
+TPU-native analog is XLA's fake host devices
+(``--xla_force_host_platform_device_count=8``).  This container pins
+JAX_PLATFORMS=axon via sitecustomize *before* pytest starts, and the axon
+PJRT plugin initializes jax eagerly, so flipping env vars in-process is too
+late — instead, re-exec the interpreter once with a scrubbed environment.
+"""
+
+import os
+import sys
+
+_N_DEVICES = "8"
+
+if os.environ.get("SKYTPU_TEST_REEXEC") != "1" and "jax" not in sys.modules:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # disables the axon TPU plugin
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={_N_DEVICES}"
+    ).strip()
+    env["SKYTPU_TEST_REEXEC"] = "1"
+    os.execvpe(
+        sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env
+    )
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == int(_N_DEVICES), (
+        f"expected {_N_DEVICES} fake CPU devices, got {devs}"
+    )
+    return devs

@@ -1,0 +1,78 @@
+"""Registry / config / builder spine tests."""
+
+import os
+
+import numpy as np
+import pytest
+
+from skycomputing_tpu.config import Config, load_config
+from skycomputing_tpu.registry import LAYER, Registry
+
+
+def test_registry_register_and_get():
+    reg = Registry("test")
+
+    @reg.register_module
+    class Foo:
+        pass
+
+    assert reg.get_module("Foo") is Foo
+    assert "Foo" in reg
+    with pytest.raises(KeyError):
+        reg.get_module("Bar")
+
+
+def test_registry_duplicate_rejected():
+    reg = Registry("test")
+
+    @reg.register_module
+    class Foo:
+        pass
+
+    with pytest.raises(KeyError):
+        @reg.register_module(name="Foo")
+        class Other:
+            pass
+
+
+def test_layer_registry_flax_fallback():
+    # Reference falls back to torch.nn names; ours falls back to flax.linen.
+    dense_cls = LAYER.get_module("Dense")
+    import flax.linen as nn
+
+    assert dense_cls is nn.Dense
+
+
+def test_config_attr_access():
+    cfg = Config.from_dict({"a": 1, "b": {"c": 2}})
+    assert cfg.a == 1
+    assert cfg["b"]["c"] == 2
+    with pytest.raises(AttributeError):
+        _ = cfg.missing
+
+
+def test_load_config_with_base(tmp_path):
+    base = tmp_path / "base.py"
+    base.write_text("x = 1\ny = 'base'\n")
+    child = tmp_path / "child.py"
+    child.write_text("base = 'base.py'\ny = 'child'\nz = [1, 2]\n")
+    cfg = load_config(str(child))
+    assert cfg.x == 1
+    assert cfg.y == "child"
+    assert cfg.z == [1, 2]
+
+
+def test_build_layer_stack_mlp():
+    import jax
+
+    from skycomputing_tpu.builder import build_layer_stack
+
+    model_cfg = [
+        {"layer_type": "Dense", "features": 16},
+        {"layer_type": "Dense", "features": 4},
+    ]
+    stack = build_layer_stack(model_cfg)
+    x = np.ones((2, 8), np.float32)
+    params = stack.init(jax.random.key(0), x)
+    out = stack.apply(params, x)
+    assert out.shape == (2, 4)
