@@ -1,0 +1,26 @@
+from .allocator import Allocator
+from .benchmarker import (
+    BaseBenchmarker,
+    DeviceBenchmarker,
+    ModelBenchmarker,
+    device_available_memory_mb,
+)
+from .estimator import Estimator
+from .parameter_server import ParameterServer
+from .solver import PartitionResult, solve_contiguous_minmax
+from .worker import Worker
+from .worker_manager import WorkerManager
+
+__all__ = [
+    "Allocator",
+    "BaseBenchmarker",
+    "DeviceBenchmarker",
+    "ModelBenchmarker",
+    "device_available_memory_mb",
+    "Estimator",
+    "ParameterServer",
+    "PartitionResult",
+    "solve_contiguous_minmax",
+    "Worker",
+    "WorkerManager",
+]

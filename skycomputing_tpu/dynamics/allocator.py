@@ -1,0 +1,273 @@
+"""Layer->worker allocation strategies.
+
+Parity with ``scaelum/dynamics/allocator.py``: three strategies over joint
+device + model profiles, writing each worker's layer slice into
+``worker.model_config``, setting pipeline ``order``, and re-ranking so rank
+equals stage order (``allocator.py:141-179``).
+
+- ``optimal_allocate`` (reference :25-179): the MIP — minimize
+  ``max_d dt[d] * sum(lf[layers of d])`` under per-device memory and
+  contiguity.  Solved by the built-in exact/greedy solver
+  (:mod:`.solver`) instead of shelling out to CBC; same math, no native
+  solver dependency.
+- ``dynamic_allocate`` (reference :181-257): even split, then memory repair,
+  then iterative flops x time balancing.
+- ``even_allocate`` (reference :259-293): floor division + remainder spread.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+from ..utils import Logger
+from .benchmarker import DeviceBenchmarker, ModelBenchmarker
+from .solver import solve_contiguous_minmax
+from .worker_manager import WorkerManager
+
+
+class Allocator:
+    def __init__(
+        self,
+        model_cfg: List[Dict],
+        worker_manager: WorkerManager,
+        model_benchmarker: ModelBenchmarker,
+        device_benchmarker: DeviceBenchmarker,
+        logger: Optional[Logger] = None,
+    ):
+        self._model_cfg = model_cfg
+        self._worker_manager = worker_manager
+        self._model_benchmarker = model_benchmarker
+        self._device_benchmarker = device_benchmarker
+        self._logger = logger or Logger()
+
+    # ------------------------------------------------------------------ util
+    def _profiles(self):
+        device_results = self._device_benchmarker.benchmark()
+        layer_flops, layer_mem = self._model_benchmarker.benchmark()
+
+        worker_ranks = [
+            int(name.lstrip("worker")) for name in device_results.keys()
+        ]
+        perf = list(device_results.values())
+        device_time = [p["time"] for p in perf]
+        device_mem = [p["avai_mem"] for p in perf]
+        return worker_ranks, device_time, device_mem, layer_flops, layer_mem
+
+    def _apply_partition(
+        self,
+        worker_ranks: List[int],
+        ranges: List[Optional[Tuple[int, int]]],
+        orders: List[int],
+    ) -> WorkerManager:
+        """Write layer slices + pipeline order onto workers, then re-rank."""
+        for rank, rng, order in zip(worker_ranks, ranges, orders):
+            worker = self._worker_manager.get_by_rank(rank)
+            if rng is None:
+                worker.model_config = []
+            else:
+                worker.model_config = self._model_cfg[rng[0] : rng[1]]
+            worker.order = order
+            self._logger.info(
+                f"worker rank {rank}: layers {rng}, pipeline order {order}"
+            )
+        self._worker_manager.reset_rank_by_order()
+        return self._worker_manager
+
+    # --------------------------------------------------------------- optimal
+    def optimal_allocate(
+        self, max_time: float = 300, threads: int = 24
+    ) -> WorkerManager:
+        """MIP-equivalent bottleneck-optimal allocation.
+
+        ``max_time``/``threads`` are accepted for reference-signature parity;
+        the built-in solver needs neither a time limit nor thread tuning at
+        these problem sizes.
+        """
+        (worker_ranks, device_time, device_mem, layer_flops, layer_mem) = (
+            self._profiles()
+        )
+        self._logger.info(
+            f"optimal_allocate: {len(layer_flops)} layers over "
+            f"{len(worker_ranks)} workers; device_time={device_time}"
+        )
+
+        result = solve_contiguous_minmax(
+            layer_cost=layer_flops,
+            layer_mem=layer_mem,
+            device_time=device_time,
+            device_mem=device_mem,
+        )
+        self._logger.info(
+            f"optimal bottleneck: {result.bottleneck:.4g} "
+            f"(device order {result.device_order})"
+        )
+
+        ranges = result.as_ranges(len(worker_ranks))
+        # Pipeline order: devices in slice order first, empty devices after.
+        orders = [0] * len(worker_ranks)
+        pos = 1
+        for d in result.device_order:
+            orders[d] = pos
+            pos += 1
+        for d in range(len(worker_ranks)):
+            if ranges[d] is None:
+                orders[d] = pos
+                pos += 1
+        return self._apply_partition(worker_ranks, ranges, orders)
+
+    # --------------------------------------------------------------- dynamic
+    def dynamic_allocate(self, break_iter: int = 1000) -> WorkerManager:
+        """Greedy: even split -> memory repair -> flops x time balancing."""
+        (worker_ranks, device_time, device_mem, layer_flops, layer_mem) = (
+            self._profiles()
+        )
+
+        if min(device_mem) <= min(layer_mem):
+            raise RuntimeError(
+                "The smallest worker has insufficient memory for the "
+                "smallest layer"
+            )
+
+        num_layer = len(layer_flops)
+        num_worker = len(worker_ranks)
+        avg = math.floor(num_layer / num_worker)
+        remainder = num_layer - avg * num_worker
+        counts = [avg + (1 if i < remainder else 0) for i in range(num_worker)]
+        partition_idx = [0]
+        for c in counts:
+            partition_idx.append(partition_idx[-1] + c)
+
+        partition_idx = self._allocate_by_mem(
+            partition_idx, device_mem, layer_mem
+        )
+        partition_idx = self._allocate_by_flops_time(
+            partition_idx, device_time, layer_flops, device_mem, layer_mem,
+            break_iter,
+        )
+
+        ranges: List[Optional[Tuple[int, int]]] = [
+            (partition_idx[i], partition_idx[i + 1]) for i in range(num_worker)
+        ]
+        orders = list(range(1, num_worker + 1))
+        return self._apply_partition(worker_ranks, ranges, orders)
+
+    # ------------------------------------------------------------------ even
+    def even_allocate(self) -> WorkerManager:
+        """Pure arithmetic split, no profiling (reference :259-293)."""
+        pool = self._worker_manager.worker_pool
+        num_worker = len(pool)
+        num_layer = len(self._model_cfg)
+        avg = math.floor(num_layer / num_worker)
+        remainder = num_layer - avg * num_worker
+
+        cursor = 0
+        for idx, worker in enumerate(pool):
+            take = avg + (1 if idx < remainder else 0)
+            worker.model_config = self._model_cfg[cursor : cursor + take]
+            worker.order = idx + 1
+            cursor += take
+        return self._worker_manager
+
+    # -------------------------------------------------- greedy repair passes
+    @staticmethod
+    def _mem_allocated(layer_mem, partition_idx):
+        return [
+            sum(layer_mem[partition_idx[j] : partition_idx[j + 1]])
+            for j in range(len(partition_idx) - 1)
+        ]
+
+    def _allocate_by_mem(self, partition_idx, device_mem, layer_mem):
+        """Shift slice boundaries until every device fits its slice.
+
+        Reference ``_allocate_by_mem`` (:370-439): walk adjacent pairs,
+        move boundary left when over capacity, right when there's headroom.
+        """
+        num_worker = len(device_mem)
+        for _ in range(10 * num_worker * max(len(layer_mem), 1)):
+            allocated = self._mem_allocated(layer_mem, partition_idx)
+            if all(a <= m for a, m in zip(allocated, device_mem)):
+                return partition_idx
+            old = list(partition_idx)
+            for j in range(num_worker - 1):
+                # shrink overfull worker j from the right
+                while (
+                    self._mem_allocated(layer_mem, partition_idx)[j]
+                    > device_mem[j]
+                    and partition_idx[j + 1] - partition_idx[j] > 1
+                ):
+                    partition_idx[j + 1] -= 1
+                # grow underfull worker j if the next can spare layers
+                while (
+                    partition_idx[j + 2] - partition_idx[j + 1] > 1
+                    and sum(
+                        layer_mem[partition_idx[j] : partition_idx[j + 1] + 1]
+                    )
+                    < device_mem[j]
+                    and self._mem_allocated(layer_mem, partition_idx)[j + 1]
+                    > device_mem[j + 1]
+                ):
+                    partition_idx[j + 1] += 1
+            if old == partition_idx:
+                break
+        allocated = self._mem_allocated(layer_mem, partition_idx)
+        if all(a <= m for a, m in zip(allocated, device_mem)):
+            return partition_idx
+        raise RuntimeError(f"memory allocation failed: {partition_idx}")
+
+    def _allocate_by_flops_time(
+        self, partition_idx, device_time, layer_flops, device_mem, layer_mem,
+        break_iter,
+    ):
+        """Iteratively move boundaries toward equal flops x time per worker.
+
+        Reference ``_allocate_by_flops_time`` (:295-368): compare each
+        worker's load to the average target; grow cheap workers by one layer
+        (memory permitting), shrink expensive ones.
+        """
+        norm = min(device_time)
+        rel_time = [t / norm for t in device_time]
+        num_worker = len(device_time)
+
+        def load(j, idx):
+            return sum(layer_flops[idx[j] : idx[j + 1]]) * rel_time[j]
+
+        for _ in range(break_iter):
+            target = sum(load(j, partition_idx) for j in range(num_worker)) / (
+                num_worker
+            )
+            old = list(partition_idx)
+            for j in range(num_worker - 1):
+                current = load(j, partition_idx)
+                if (
+                    current < target
+                    and partition_idx[j + 2] - partition_idx[j + 1] > 1
+                ):
+                    expected_mem = sum(
+                        layer_mem[partition_idx[j] : partition_idx[j + 1] + 1]
+                    )
+                    if expected_mem < device_mem[j]:
+                        partition_idx[j + 1] += 1
+                else:
+                    last_layer_cost = (
+                        layer_flops[partition_idx[j + 1] - 1] * rel_time[j]
+                    )
+                    next_load = load(j + 1, partition_idx)
+                    if (
+                        next_load < target
+                        and current > target + last_layer_cost
+                        and partition_idx[j + 1] - partition_idx[j] > 1
+                    ):
+                        next_expected_mem = sum(
+                            layer_mem[
+                                partition_idx[j + 1] - 1 : partition_idx[j + 2]
+                            ]
+                        )
+                        if next_expected_mem < device_mem[j + 1]:
+                            partition_idx[j + 1] -= 1
+            if old == partition_idx:
+                break
+        return partition_idx
+
+
+__all__ = ["Allocator"]

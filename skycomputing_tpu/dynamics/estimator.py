@@ -1,0 +1,133 @@
+"""Static + timed measurement helpers.
+
+TPU-native replacement for the reference ``Estimator``
+(``scaelum/dynamics/estimator.py:15-152``):
+
+- FLOPs come from XLA's own cost model
+  (``jit(f).lower(...).compile().cost_analysis()['flops']``) instead of
+  pthflops' torch-JIT tracing;
+- memory uses the same accounting *formula* as the reference (param_scale x
+  params + 2 x outputs + inputs, 4-byte floats, MB units) so the allocator
+  interface is unchanged, but sizes are exact from avals instead of hook
+  guesswork;
+- speed measurement respects XLA async dispatch: warm-up compile, then
+  ``block_until_ready`` timing.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _as_tuple(data) -> Tuple:
+    return data if isinstance(data, tuple) else (data,)
+
+
+def _aval_bytes(tree, bytes_per_number: float = None) -> float:
+    total = 0.0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        n = float(np.prod(leaf.shape)) if leaf.shape else 1.0
+        itemsize = (
+            bytes_per_number
+            if bytes_per_number is not None
+            else jnp.dtype(leaf.dtype).itemsize
+        )
+        total += n * itemsize
+    return total
+
+
+class Estimator:
+    """Stateless measurement helpers (kept as a namespace class for parity)."""
+
+    @staticmethod
+    def benchmark_speed(
+        fn: Callable,
+        args: Sequence[Any],
+        device=None,
+        iterations: int = 30,
+        warmup: int = 3,
+    ) -> float:
+        """Total wall-clock of ``iterations`` executions of jitted ``fn``.
+
+        Honest timing on an async, compiled runtime requires placing inputs on
+        the target device, compiling + warming up first, and blocking on the
+        final output (reference analog: 30 no-grad forwards,
+        ``estimator.py:15-34``).
+        """
+        jitted = jax.jit(fn)
+        if device is not None:
+            args = jax.device_put(list(args), device)
+        out = None
+        for _ in range(max(warmup, 1)):
+            out = jitted(*args)
+        jax.block_until_ready(out)
+
+        start = time.perf_counter()
+        for _ in range(iterations):
+            out = jitted(*args)
+        jax.block_until_ready(out)
+        return time.perf_counter() - start
+
+    @staticmethod
+    def benchmark_model(
+        module,
+        data: Sequence[Any],
+        param_scale: int = 2,
+        rng: jax.Array = None,
+    ):
+        """(output_avals, flops, mem_MB) for one layer — fully static.
+
+        No parameters are materialized and no FLOPs are executed: ``init`` and
+        ``apply`` are shape-traced with ``jax.eval_shape`` and FLOPs come from
+        compiling the apply against abstract inputs.  This is what lets the
+        model benchmarker profile a 160-layer BERT without OOM — the
+        reference needed a hard-coded BERT shortcut for that
+        (``benchmarker.py:163-166``).
+        """
+        if rng is None:
+            rng = jax.random.key(0)
+        data = _as_tuple(data)
+        avals = tuple(
+            jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype)
+            if not isinstance(x, jax.ShapeDtypeStruct)
+            else x
+            for x in data
+        )
+
+        variables_aval = jax.eval_shape(
+            lambda *xs: module.init({"params": rng, "dropout": rng}, *xs),
+            *avals,
+        )
+        params_aval = variables_aval["params"]
+
+        def apply_fn(params, *xs):
+            return module.apply({"params": params}, *xs, rngs={"dropout": rng})
+
+        out_aval = jax.eval_shape(apply_fn, params_aval, *avals)
+
+        compiled = jax.jit(apply_fn).lower(params_aval, *avals).compile()
+        flops = float(compiled.cost_analysis().get("flops", 0.0))
+
+        mb = 1024.0**2
+        # Reference formula (estimator.py:85-152): inputs + 2x outputs (grads)
+        # + param_scale x params, at 4 bytes/number.
+        input_size = _aval_bytes(avals, 4.0) / mb
+        output_size = 2.0 * _aval_bytes(out_aval, 4.0) / mb
+        param_size = param_scale * _aval_bytes(params_aval, 4.0) / mb
+        mem_usage = input_size + output_size + param_size
+
+        return out_aval, flops, mem_usage
+
+    @staticmethod
+    def measure_flops(fn: Callable, *args) -> float:
+        """XLA-reported FLOPs of an arbitrary jittable function."""
+        compiled = jax.jit(fn).lower(*args).compile()
+        return float(compiled.cost_analysis().get("flops", 0.0))
+
+
+__all__ = ["Estimator"]

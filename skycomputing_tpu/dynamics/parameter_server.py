@@ -1,0 +1,84 @@
+"""Host-resident full-model parameter store.
+
+Parity with ``scaelum/dynamics/parameter_server.py:14-39``: rank 0 keeps a
+complete copy of the model, loads/saves a single-file whole-model checkpoint,
+and exchanges per-layer state with pipeline stages.  Because the store is
+**layer-indexed** (a list of per-layer param pytrees), a checkpoint survives
+re-allocation: stages slice it by their current layer ranges
+(``checkpoint_hook.py:31-40`` behavior).
+
+Serialization uses flax msgpack (``flax.serialization``) — the ``.pth``
+analog, no torch involved.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from flax import serialization
+
+from ..builder import build_layer_stack
+
+
+class ParameterServer:
+    def __init__(
+        self,
+        model_config: List[Dict],
+        example_inputs: Optional[Sequence[Any]] = None,
+        rng: Optional[jax.Array] = None,
+        init: bool = True,
+    ):
+        self._model_config = list(model_config)
+        self.stack = build_layer_stack(self._model_config)
+        self.params: List[Any] = []
+        if init:
+            if example_inputs is None:
+                raise ValueError(
+                    "example_inputs required to initialize the parameter server"
+                )
+            if rng is None:
+                rng = jax.random.key(0)
+            # keep the master copy on host memory, off the accelerators
+            with jax.default_device(jax.devices("cpu")[0]):
+                self.params = self.stack.init(rng, *example_inputs)
+
+    @property
+    def num_layers(self) -> int:
+        return len(self._model_config)
+
+    # --- whole-model checkpoint io -----------------------------------------
+    def state_bytes(self) -> bytes:
+        host_params = jax.tree_util.tree_map(np.asarray, self.params)
+        return serialization.msgpack_serialize({"layers": host_params})
+
+    def save_weights_to_file(self, checkpoint: str) -> None:
+        with open(checkpoint, "wb") as fh:
+            fh.write(self.state_bytes())
+
+    def load_weights_from_file(self, checkpoint: str) -> None:
+        with open(checkpoint, "rb") as fh:
+            restored = serialization.msgpack_restore(fh.read())
+        layers = restored["layers"]
+        if isinstance(layers, dict):  # msgpack may round-trip lists as dicts
+            layers = [layers[k] for k in sorted(layers, key=int)]
+        if self.params:
+            layers = [
+                serialization.from_state_dict(ref, serialization.to_state_dict(new))
+                for ref, new in zip(self.params, layers)
+            ]
+        self.params = list(layers)
+
+    # --- per-layer exchange with stages ------------------------------------
+    def update_weights(self, state: Any, idx: int) -> None:
+        self.params[idx] = jax.tree_util.tree_map(np.asarray, state)
+
+    def get_state_dict(self, idx: int) -> Any:
+        return self.params[idx]
+
+    def get_layer_slice(self, start: int, stop: int) -> List[Any]:
+        return self.params[start:stop]
+
+
+__all__ = ["ParameterServer"]

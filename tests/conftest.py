@@ -13,7 +13,10 @@ import sys
 
 _N_DEVICES = "8"
 
-if os.environ.get("SKYTPU_TEST_REEXEC") != "1" and "jax" not in sys.modules:
+# NOTE: sitecustomize imports jax eagerly, so "jax" is in sys.modules even
+# here — that's fine: execvpe replaces the process, and in the child the
+# scrubbed env means sitecustomize skips the TPU plugin entirely.
+if os.environ.get("SKYTPU_TEST_REEXEC") != "1":
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # disables the axon TPU plugin
     env["JAX_PLATFORMS"] = "cpu"

@@ -1,0 +1,219 @@
+"""Dynamics subsystem: benchmarkers, stimulator, allocator, parameter server."""
+
+import jax
+import numpy as np
+import pytest
+
+from skycomputing_tpu.dynamics import (
+    Allocator,
+    DeviceBenchmarker,
+    Estimator,
+    ModelBenchmarker,
+    ParameterServer,
+    WorkerManager,
+)
+from skycomputing_tpu.dataset import RandomTensorGenerator, RandomTokenGenerator
+from skycomputing_tpu.models import bert_config, bert_layer_configs
+from skycomputing_tpu.stimulator import Stimulator
+
+
+def make_worker_manager(n=4, mem_limit=-1):
+    wm = WorkerManager()
+    wm.load_worker_pool_from_config(
+        [
+            dict(
+                name=f"node-{i}",
+                device_config=dict(device_index=i),
+                extra_config=dict(mem_limit=mem_limit, slowdown=1.0),
+            )
+            for i in range(n)
+        ]
+    )
+    return wm
+
+
+def tiny_model_cfg(units=2):
+    cfg = bert_config("tiny", dtype="float32", hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)
+    return bert_layer_configs(cfg, num_encoder_units=units, deterministic=True)
+
+
+class FakeDeviceBenchmarker:
+    """Deterministic device profile for allocator unit tests."""
+
+    def __init__(self, times, mems):
+        self._times = times
+        self._mems = mems
+
+    def benchmark(self):
+        return {
+            f"worker{i}": dict(time=t, avai_mem=m)
+            for i, (t, m) in enumerate(zip(self._times, self._mems))
+        }
+
+
+class FakeModelBenchmarker:
+    def __init__(self, flops, mems):
+        self._flops = flops
+        self._mems = mems
+
+    def benchmark(self):
+        return list(self._flops), list(self._mems)
+
+
+def test_stimulator_ranges_and_determinism():
+    s1 = Stimulator(8)
+    s2 = Stimulator(8)
+    assert np.allclose(s1.c_slowdown, s2.c_slowdown)
+    assert np.all(s1.m_slowdown >= 1.0) and np.all(s1.m_slowdown < 3.0)
+    assert np.all(s1.n_slowdown >= 1.0) and np.all(s1.n_slowdown < 2.0)
+    assert np.all(s1.c_slowdown >= 1.0) and np.all(s1.c_slowdown < 4.0)
+    # compute and network draws must differ (reference bug: shared seed)
+    assert not np.allclose(s1.c_slowdown, s1.n_slowdown)
+
+
+def test_model_benchmarker_bert_static_profile():
+    model_cfg = tiny_model_cfg(units=3)  # 1 + 9 + 2 = 12 layers
+    gen = RandomTokenGenerator(batch_size=2, seq_length=16, vocab_size=1024)
+    mb = ModelBenchmarker(model_cfg, gen)
+    flops, mem = mb.benchmark()
+    assert len(flops) == 12 and len(mem) == 12
+    assert all(f > 0 for f in flops)
+    assert all(m > 0 for m in mem)
+    # encoder trios repeat -> identical profiles for repeated units
+    assert flops[1:4] == flops[4:7] == flops[7:10]
+    # embeddings layer holds the vocab table -> largest memory
+    assert mem[0] == max(mem)
+
+
+def test_device_benchmarker_profiles_all_workers(devices):
+    wm = make_worker_manager(4)
+    proxy_cfg = [dict(layer_type="MatmulStack", features=64, depth=2,
+                      dtype="float32")]
+    gen = RandomTensorGenerator(size=(4, 64))
+    db = DeviceBenchmarker(wm, gen, proxy_cfg, iterations=3)
+    results = db.benchmark()
+    assert set(results) == {f"worker{i}" for i in range(4)}
+    for v in results.values():
+        assert v["time"] > 0
+        assert v["avai_mem"] > 0
+
+
+def test_device_benchmarker_stimulated_heterogeneity(devices):
+    wm = make_worker_manager(4)
+    proxy_cfg = [dict(layer_type="MatmulStack", features=64, depth=2,
+                      dtype="float32")]
+    gen = RandomTensorGenerator(size=(4, 64))
+    stim = Stimulator(4)
+    base = DeviceBenchmarker(wm, gen, proxy_cfg, iterations=3).benchmark()
+    hot = DeviceBenchmarker(
+        wm, gen, proxy_cfg, iterations=3, stimulator=stim
+    ).benchmark()
+    ratios = [
+        hot[f"worker{i}"]["time"] / max(base[f"worker{i}"]["time"], 1e-12)
+        for i in range(4)
+    ]
+    # stimulated times should be scaled by distinct factors >= 1
+    assert max(ratios) > 1.2
+    assert len({round(r, 2) for r in ratios}) > 1
+
+
+def _make_allocator(times, mems, flops, lmem, n_layers=8):
+    model_cfg = [dict(layer_type="Dense", features=8)] * n_layers
+    wm = make_worker_manager(len(times))
+    return Allocator(
+        model_cfg,
+        wm,
+        FakeModelBenchmarker(flops, lmem),
+        FakeDeviceBenchmarker(times, mems),
+    ), wm
+
+
+def test_even_allocate_splits_remainder():
+    alloc, wm = _make_allocator([1, 1, 1], [100] * 3, [1] * 8, [1] * 8)
+    alloc.even_allocate()
+    counts = [len(w.model_config) for w in wm.worker_pool]
+    assert counts == [3, 3, 2]
+
+
+def test_optimal_allocate_prefers_fast_workers():
+    # worker2 is 5x slower: it should get far fewer layers than even share
+    alloc, wm = _make_allocator(
+        [1.0, 1.0, 5.0], [1000.0] * 3, [1.0] * 30, [0.1] * 30, n_layers=30
+    )
+    alloc.optimal_allocate()
+    by_rank = {w.rank: len(w.model_config) for w in wm.worker_pool}
+    # after re-rank, ranks are pipeline order 0..2; find the slow worker
+    slow = [w for w in wm.worker_pool if w.name == "node-2"][0]
+    fast_counts = [
+        len(w.model_config) for w in wm.worker_pool if w.name != "node-2"
+    ]
+    assert len(slow.model_config) < min(fast_counts)
+    assert sum(by_rank.values()) == 30
+    # ranks are contiguous pipeline positions
+    assert sorted(by_rank) == [0, 1, 2]
+
+
+def test_optimal_allocate_respects_memory():
+    # fastest worker can only hold 2 layers' memory
+    alloc, wm = _make_allocator(
+        [0.1, 1.0, 1.0], [2.0, 100.0, 100.0], [1.0] * 12, [1.0] * 12,
+        n_layers=12,
+    )
+    alloc.optimal_allocate()
+    fast = [w for w in wm.worker_pool if w.name == "node-0"][0]
+    assert len(fast.model_config) <= 2
+
+
+def test_dynamic_allocate_balances():
+    alloc, wm = _make_allocator(
+        [1.0, 2.0], [1000.0] * 2, [1.0] * 12, [0.1] * 12, n_layers=12
+    )
+    alloc.dynamic_allocate()
+    counts = {w.name: len(w.model_config) for w in wm.worker_pool}
+    assert sum(counts.values()) == 12
+    assert counts["node-0"] > counts["node-1"]
+
+
+def test_allocation_slices_reassemble_model():
+    alloc, wm = _make_allocator(
+        [1.0, 1.3, 2.0], [1000.0] * 3, list(np.linspace(1, 2, 9)),
+        [0.1] * 9, n_layers=9,
+    )
+    alloc.optimal_allocate()
+    total = []
+    for w in sorted(wm.worker_pool, key=lambda w: w.rank):
+        total.extend(w.model_config)
+    assert total == alloc._model_cfg
+
+
+def test_parameter_server_roundtrip(tmp_path):
+    model_cfg = tiny_model_cfg(units=1)
+    ids = np.ones((2, 8), np.int32)
+    ps = ParameterServer(model_cfg, example_inputs=(ids, ids * 0, ids * 0 + 1))
+    assert ps.num_layers == len(model_cfg)
+
+    ckpt = str(tmp_path / "epoch_1.msgpack")
+    ps.save_weights_to_file(ckpt)
+
+    ps2 = ParameterServer(
+        model_cfg, example_inputs=(ids, ids * 0, ids * 0 + 1),
+        rng=jax.random.key(42),
+    )
+
+    def total_diff(a, b):
+        return sum(
+            float(np.abs(np.asarray(x) - np.asarray(y)).sum())
+            for x, y in zip(
+                jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+            )
+        )
+
+    assert total_diff(ps.params, ps2.params) > 0  # different init seeds
+    ps2.load_weights_from_file(ckpt)
+    assert total_diff(ps.params, ps2.params) == 0  # restored exactly
+
+    # per-layer exchange
+    sd = ps.get_state_dict(1)
+    ps2.update_weights(jax.tree_util.tree_map(lambda x: x * 0, sd), 1)
+    assert float(np.abs(jax.tree_util.tree_leaves(ps2.params[1])[0]).sum()) == 0

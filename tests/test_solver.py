@@ -1,0 +1,126 @@
+"""Solver correctness: exact DP vs brute force, memory constraints, scale."""
+
+import itertools
+import random
+
+import pytest
+
+from skycomputing_tpu.dynamics.solver import solve_contiguous_minmax
+
+
+def brute_force_minmax(layer_cost, layer_mem, device_time, device_mem):
+    """Enumerate all device orders x contiguous splits (tiny instances)."""
+    L, D = len(layer_cost), len(device_time)
+    best = float("inf")
+
+    def splits(n_layers, n_parts):
+        # all compositions of n_layers into n_parts non-negative parts
+        if n_parts == 1:
+            yield (n_layers,)
+            return
+        for first in range(n_layers + 1):
+            for rest in splits(n_layers - first, n_parts - 1):
+                yield (first,) + rest
+
+    for perm in itertools.permutations(range(D)):
+        for comp in splits(L, D):
+            pos = 0
+            ok = True
+            worst = 0.0
+            for d, take in zip(perm, comp):
+                seg_cost = sum(layer_cost[pos : pos + take])
+                seg_mem = sum(layer_mem[pos : pos + take])
+                if seg_mem > device_mem[d] + 1e-9:
+                    ok = False
+                    break
+                worst = max(worst, device_time[d] * seg_cost)
+                pos += take
+            if ok:
+                best = min(best, worst)
+    return best
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_exact_matches_brute_force(seed):
+    rng = random.Random(seed)
+    L = rng.randint(4, 8)
+    D = rng.randint(2, 4)
+    layer_cost = [rng.uniform(0.5, 3.0) for _ in range(L)]
+    layer_mem = [rng.uniform(0.5, 2.0) for _ in range(L)]
+    device_time = [rng.uniform(1.0, 4.0) for _ in range(D)]
+    # memory generous enough that some assignment is always feasible
+    device_mem = [sum(layer_mem) for _ in range(D)]
+
+    result = solve_contiguous_minmax(
+        layer_cost, layer_mem, device_time, device_mem, tolerance=1e-6
+    )
+    expected = brute_force_minmax(layer_cost, layer_mem, device_time, device_mem)
+    assert result.bottleneck == pytest.approx(expected, rel=1e-3)
+
+
+def test_memory_constraint_respected():
+    # 4 equal layers; device 0 is 100x faster but can only hold one layer.
+    layer_cost = [1.0] * 4
+    layer_mem = [1.0] * 4
+    device_time = [0.01, 1.0, 1.0]
+    device_mem = [1.0, 4.0, 4.0]
+    result = solve_contiguous_minmax(
+        layer_cost, layer_mem, device_time, device_mem, tolerance=1e-6
+    )
+    ranges = result.as_ranges(3)
+    if ranges[0] is not None:
+        start, end = ranges[0]
+        assert end - start <= 1
+    # all layers covered, disjoint and contiguous
+    covered = sorted(r for r in ranges if r is not None)
+    pos = 0
+    total = 0
+    for s, e in covered:
+        assert s == pos
+        pos = e
+        total += e - s
+    assert total == 4
+
+
+def test_infeasible_raises():
+    with pytest.raises(RuntimeError, match="infeasible"):
+        solve_contiguous_minmax(
+            [1.0, 1.0], [10.0, 10.0], [1.0, 1.0], [1.0, 1.0]
+        )
+
+
+def test_heterogeneous_beats_even_bottleneck():
+    # Slow devices should get fewer layers than even split would give.
+    L = 32
+    layer_cost = [1.0] * L
+    layer_mem = [0.1] * L
+    device_time = [1.0, 1.0, 4.0, 4.0]
+    device_mem = [100.0] * 4
+    result = solve_contiguous_minmax(
+        layer_cost, layer_mem, device_time, device_mem, tolerance=1e-6
+    )
+    even_bottleneck = 4.0 * (L / 4)  # slowest device with even share
+    assert result.bottleneck < even_bottleneck * 0.45
+
+
+def test_large_cluster_greedy_path():
+    rng = random.Random(7)
+    L, D = 160, 64
+    layer_cost = [rng.uniform(0.5, 1.5) for _ in range(L)]
+    layer_mem = [rng.uniform(0.5, 1.5) for _ in range(L)]
+    device_time = [rng.uniform(1.0, 4.0) for _ in range(D)]
+    device_mem = [rng.uniform(5.0, 12.0) for _ in range(D)]
+
+    result = solve_contiguous_minmax(
+        layer_cost, layer_mem, device_time, device_mem
+    )
+    # sanity: covers all layers exactly once, respects memory
+    ranges = [r for r in result.as_ranges(D) if r is not None]
+    ranges.sort()
+    pos = 0
+    for s, e in ranges:
+        assert s == pos
+        pos = e
+    assert pos == L
+    for d, (s, e) in zip(result.device_order, result.slices):
+        assert sum(layer_mem[s:e]) <= device_mem[d] + 1e-9
