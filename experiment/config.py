@@ -1,0 +1,123 @@
+"""Declarative experiment config.
+
+TPU-native analog of the reference experiment config
+(``/root/reference/experiment/config.py``): same experiment knobs
+(ALLOCATE_TYPE / CORE_NUM / LAYER_NUM, BERT-large MNLI fine-tune, SGD), but
+no RPC/Gloo/Slurm machinery — a single controller owns every device.
+Environment overrides (all optional):
+
+- ``SKYTPU_ALLOCATE_TYPE``: even | optimal | dynamic
+- ``SKYTPU_CORE_NUM``: number of pipeline workers
+- ``SKYTPU_LAYER_NUM``: encoder-trio repeat count (depth scaling)
+- ``SKYTPU_PRESET``: bert preset (tiny | base | large)
+- ``SKYTPU_MAX_ITERS`` / ``SKYTPU_BATCH_SIZE`` / ``SKYTPU_MICROBATCHES``
+- ``STIMULATE``: enable the heterogeneity stimulator (reference env flag)
+"""
+
+import os
+import os.path as osp
+
+from skycomputing_tpu.models import bert_config, bert_layer_configs
+
+# allocation type, valid values are optimal, even and dynamic
+ALLOCATE_TYPE = os.getenv("SKYTPU_ALLOCATE_TYPE", "even")
+
+# number of pipeline workers (the reference counted 1 host + N-1 workers;
+# here every worker holds layers)
+CORE_NUM = int(os.getenv("SKYTPU_CORE_NUM", "4"))
+
+# encoder-trio repeat count: LAYER_NUM trios -> 3*LAYER_NUM encoder units
+LAYER_NUM = int(os.getenv("SKYTPU_LAYER_NUM", "10"))
+
+PRESET = os.getenv("SKYTPU_PRESET", "large")
+BATCH_SIZE = int(os.getenv("SKYTPU_BATCH_SIZE", "32"))
+MAX_SEQ_LENGTH = 128
+NUM_MICROBATCHES = int(os.getenv("SKYTPU_MICROBATCHES", "1"))
+
+__bert_cfg = bert_config(PRESET)
+
+# model config: 1 embeddings + LAYER_NUM encoder trios + pooler + classifier
+model_config = bert_layer_configs(
+    __bert_cfg, num_encoder_units=LAYER_NUM, num_classes=3
+)
+
+# log layout mirrors the reference experiment matrix
+__LOG_ROOT = osp.join(
+    os.getenv("SKYTPU_LOG_ROOT", "logs"),
+    f"{CORE_NUM}nodes_{LAYER_NUM}layers",
+    ALLOCATE_TYPE,
+)
+logging_config = dict(filename=osp.join(__LOG_ROOT, "allocation.log"))
+
+# worker pool: logical stages round-robined over physical devices
+worker_config = [
+    dict(
+        name=f"tpu-{i}",
+        device_config=dict(device_index=i),
+        extra_config=dict(
+            slowdown=1.0,
+            mem_limit=-1,
+        ),
+    )
+    for i in range(CORE_NUM)
+]
+
+# dataset: GLUE MNLI when SKYTPU_GLUE_DIR points at real data, else synthetic
+data_config = dict(
+    dataset_cfg=dict(
+        type="GlueDataset",
+        data_dir=os.getenv("SKYTPU_GLUE_DIR", ""),
+        vocab_file=os.getenv("SKYTPU_VOCAB_FILE", None),
+        max_seq_length=MAX_SEQ_LENGTH,
+        do_lower_case=False,
+        processor="mnli",
+    ),
+    dataloader_cfg=dict(
+        batch_size=BATCH_SIZE,
+        shuffle=True,
+    ),
+)
+
+# profiling + allocation
+allocator_config = dict(
+    type=ALLOCATE_TYPE,
+    benchmark_config=dict(
+        model=dict(
+            param_scale=2,
+            data_generator_cfg=dict(
+                generator_type="RandomTokenGenerator",
+                generator_cfg=dict(
+                    batch_size=BATCH_SIZE,
+                    seq_length=MAX_SEQ_LENGTH,
+                    vocab_size=__bert_cfg.vocab_size,
+                ),
+            ),
+        ),
+        device=dict(
+            # MXU-saturating matmul proxy (reference used 10x Conv2d)
+            model_config=[
+                dict(layer_type="MatmulStack", features=1024, depth=4)
+            ],
+            iterations=10,
+            data_generator_cfg=dict(
+                generator_type="RandomTensorGenerator",
+                generator_cfg=dict(size=(256, 1024)),
+            ),
+        ),
+    ),
+)
+
+# training
+train_config = dict(
+    optim_cfg=dict(optim_type="sgd", learning_rate=0.001),
+    loss_cfg=dict(type="CrossEntropyLoss"),
+    runner_cfg=dict(
+        max_epochs=int(os.getenv("SKYTPU_MAX_EPOCHS", "1")),
+        max_iters=int(os.getenv("SKYTPU_MAX_ITERS", "30")),
+    ),
+    hook_config=[
+        dict(type="StopHook", root=__LOG_ROOT),
+        dict(type="DistributedTimerHelperHook"),
+    ],
+    timer_config=dict(root=__LOG_ROOT),
+)

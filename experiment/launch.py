@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Single-controller experiment launcher.
+
+TPU-native replacement for the reference launcher
+(``/root/reference/experiment/launch.py:20-235``).  The reference needed
+Slurm ranks, a HOST rendezvous file, and an RPC world where rank 0
+orchestrates passive workers; under single-controller JAX one process owns
+all devices, so the launcher is just: load config -> build worker pool +
+parameter server + dataloader -> profile + allocate -> build the pipeline ->
+train.  Allocation failure degrades to a clean exit without training
+(parity with ``launch.py:117-145``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+import optax
+
+from skycomputing_tpu import load_config
+from skycomputing_tpu.builder import build_data_generator, build_dataloader_from_cfg, build_hook
+from skycomputing_tpu.dynamics import (
+    Allocator,
+    DeviceBenchmarker,
+    ModelBenchmarker,
+    ParameterServer,
+    WorkerManager,
+)
+from skycomputing_tpu.ops import build_loss
+from skycomputing_tpu.parallel import PipelineModel
+from skycomputing_tpu.runner import Runner
+from skycomputing_tpu.stimulator import Stimulator
+from skycomputing_tpu.utils import Logger
+
+
+def build_optimizer(optim_cfg: dict):
+    cfg = dict(optim_cfg)
+    name = cfg.pop("optim_type").lower()
+    return getattr(optax, name)(**cfg)
+
+
+def run(cfg, logger: Logger) -> int:
+    devices = jax.devices()
+    logger.info(
+        f"devices: {len(devices)} x {devices[0].platform} "
+        f"({devices[0].device_kind})"
+    )
+
+    # --- cluster membership -------------------------------------------------
+    worker_manager = WorkerManager()
+    worker_manager.load_worker_pool_from_config(cfg.worker_config)
+
+    # --- data ---------------------------------------------------------------
+    data_loader = build_dataloader_from_cfg(cfg.data_config)
+
+    def batches():
+        # GlueDataset rows are ((ids, mask, segs), label); BertEmbeddings
+        # takes (ids, token_type_ids, attention_mask)
+        for (ids, mask, segs), labels in data_loader:
+            yield (ids, segs, mask), labels
+
+    class BatchAdapter:
+        def __len__(self):
+            return len(data_loader)
+
+        def __iter__(self):
+            return batches()
+
+    # --- parameter server (host copy of the full model) ---------------------
+    probe = next(iter(BatchAdapter()))
+    parameter_server = ParameterServer(
+        cfg.model_config, example_inputs=probe[0], rng=jax.random.key(0)
+    )
+    logger.info(f"parameter server: {parameter_server.num_layers} layers")
+
+    # --- profiling + allocation ---------------------------------------------
+    bench_cfg = cfg.allocator_config["benchmark_config"]
+    model_bench = ModelBenchmarker(
+        cfg.model_config,
+        build_data_generator(**bench_cfg["model"]["data_generator_cfg"]),
+        param_scale=bench_cfg["model"].get("param_scale", 2),
+    )
+    stimulator = (
+        Stimulator(worker_manager.size)
+        if os.getenv("STIMULATE") is not None
+        else None
+    )
+    device_bench = DeviceBenchmarker(
+        worker_manager,
+        build_data_generator(**bench_cfg["device"]["data_generator_cfg"]),
+        bench_cfg["device"]["model_config"],
+        iterations=bench_cfg["device"].get("iterations", 10),
+        devices=devices,
+        stimulator=stimulator,
+    )
+    allocator = Allocator(
+        cfg.model_config, worker_manager, model_bench, device_bench,
+        logger=logger,
+    )
+
+    allocate_type = cfg.allocator_config["type"]
+    logger.info(f"allocation strategy: {allocate_type}")
+    try:
+        if allocate_type == "optimal":
+            allocator.optimal_allocate()
+        elif allocate_type == "dynamic":
+            allocator.dynamic_allocate()
+        elif allocate_type == "even":
+            allocator.even_allocate()
+        else:
+            raise ValueError(f"unknown ALLOCATE_TYPE {allocate_type!r}")
+    except Exception as exc:  # allocation failure -> clean exit, no training
+        logger.info(f"allocation failed: {exc!r} — skipping training")
+        return 1
+
+    for worker in worker_manager.worker_pool:
+        logger.info(
+            f"  stage rank={worker.rank} name={worker.name} "
+            f"device={worker.device_index} layers={len(worker.model_config)}"
+        )
+
+    # --- pipeline + runner ---------------------------------------------------
+    model = PipelineModel(
+        worker_manager,
+        parameter_server,
+        build_optimizer(cfg.train_config["optim_cfg"]),
+        build_loss(cfg.train_config["loss_cfg"]),
+        devices=devices,
+        num_microbatches=getattr(cfg, "NUM_MICROBATCHES", 1),
+    )
+
+    runner = Runner(
+        model,
+        parameter_server,
+        worker_manager,
+        max_epochs=cfg.train_config["runner_cfg"]["max_epochs"],
+        max_iters=cfg.train_config["runner_cfg"]["max_iters"],
+        timer_cfg=cfg.train_config.get("timer_config"),
+        logging_cfg=cfg.logging_config,
+    )
+    for hook_cfg in cfg.train_config.get("hook_config", []):
+        runner.register_hook(build_hook(hook_cfg))
+
+    runner.train(BatchAdapter())
+    summary = runner.phase_timer.summary()
+    logger.info(f"phase means (s): {summary}")
+    logger.info("training complete")
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description="skycomputing-tpu launcher")
+    parser.add_argument("-c", "--config", required=True, help="config .py path")
+    parser.add_argument(
+        "--allocate-type",
+        choices=["even", "optimal", "dynamic"],
+        help="override ALLOCATE_TYPE from the config",
+    )
+    args = parser.parse_args()
+
+    if args.allocate_type:
+        os.environ["SKYTPU_ALLOCATE_TYPE"] = args.allocate_type
+
+    cfg = load_config(args.config)
+    if args.allocate_type:
+        cfg.allocator_config["type"] = args.allocate_type
+
+    logger = Logger(**cfg.logging_config) if "logging_config" in cfg else Logger()
+    return run(cfg, logger)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

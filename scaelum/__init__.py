@@ -1,0 +1,49 @@
+"""Compatibility alias: the reference framework's package name.
+
+The reference ships as ``scaelum`` (``/root/reference/setup.py:21-22``); this
+module lets reference users keep their imports while getting the TPU-native
+implementation.  ``import scaelum`` re-exports the full
+:mod:`skycomputing_tpu` API surface under the familiar names, including the
+``scaelum.dynamics`` / ``scaelum.runner`` / ... submodule paths.
+"""
+
+import sys as _sys
+
+import skycomputing_tpu as _impl
+from skycomputing_tpu import *  # noqa: F401,F403
+from skycomputing_tpu import (
+    builder,
+    config,
+    dataset,
+    dynamics,
+    models,
+    ops,
+    parallel,
+    registry,
+    runner,
+    stimulator,
+    utils,
+)
+
+# familiar submodule paths: scaelum.dynamics, scaelum.runner, ...
+for _name in (
+    "builder",
+    "config",
+    "dataset",
+    "dynamics",
+    "models",
+    "ops",
+    "parallel",
+    "registry",
+    "runner",
+    "stimulator",
+    "utils",
+):
+    _sys.modules[f"scaelum.{_name}"] = getattr(_impl, _name)
+
+# the reference exposed the model zoo as ``scaelum.model``
+_sys.modules["scaelum.model"] = models
+model = models
+
+__version__ = _impl.__version__
+__all__ = list(getattr(_impl, "__all__", [])) + ["model"]

@@ -14,6 +14,30 @@ from .config import Config, load_config
 from .registry import DATA_GENERATOR, DATASET, HOOKS, LAYER, LOSS, MODEL, Registry
 from .utils import Logger, DistributedTimer, get_time, generate_worker_name
 
+# Root re-exports of the main subsystem classes, as the reference does
+# (``scaelum/__init__.py:1-11``).  Submodule imports stay lazy-free: these
+# pull in jax/flax, which is fine for a framework package.
+from .builder import (
+    build_dataloader_from_cfg,
+    build_hook,
+    build_layer,
+    build_layer_stack,
+    build_module_from_cfg,
+    LayerStack,
+)
+from .dynamics import (
+    Allocator,
+    DeviceBenchmarker,
+    Estimator,
+    ModelBenchmarker,
+    ParameterServer,
+    Worker,
+    WorkerManager,
+)
+from .parallel import PipelineModel, StageRuntime
+from .runner import Hook, Runner
+from .stimulator import Stimulator
+
 __all__ = [
     "Config",
     "load_config",
@@ -28,5 +52,23 @@ __all__ = [
     "DistributedTimer",
     "get_time",
     "generate_worker_name",
+    "build_dataloader_from_cfg",
+    "build_hook",
+    "build_layer",
+    "build_layer_stack",
+    "build_module_from_cfg",
+    "LayerStack",
+    "Allocator",
+    "DeviceBenchmarker",
+    "Estimator",
+    "ModelBenchmarker",
+    "ParameterServer",
+    "Worker",
+    "WorkerManager",
+    "PipelineModel",
+    "StageRuntime",
+    "Hook",
+    "Runner",
+    "Stimulator",
     "__version__",
 ]

@@ -1,0 +1,214 @@
+"""ResNet decomposed into pipeline-splittable units, in Flax.
+
+Parity with the reference CNN zoo (``/root/reference/scaelum/model/layers.py:
+6-261``): ``ResHead`` (stem) / ``ResLayer`` (one stage of residual blocks) /
+``ResTail`` (pool + classifier) registered units plus ``BasicBlock`` /
+``BottleNeck`` and monolithic ``resnet18..152`` constructors.
+
+TPU-first: images flow NHWC internally (XLA's native conv layout); ResHead
+accepts torch-style NCHW and transposes once on entry, ResTail emits logits,
+so reference-shaped CIFAR/ImageNet configs work unchanged.  BatchNorm is
+replaced by GroupNorm — batch statistics are cross-microbatch state that a
+pipelined execution would have to synchronize; GroupNorm is the standard
+stateless substitute and keeps every layer a pure function.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence, Tuple
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+from ..registry import LAYER
+
+
+def _norm(features: int, name: str) -> nn.Module:
+    return nn.GroupNorm(num_groups=min(32, features), name=name)
+
+
+class BasicBlock(nn.Module):
+    """3x3 + 3x3 residual block (expansion 1)."""
+
+    features: int
+    strides: int = 1
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.features, (3, 3), strides=(self.strides,) * 2,
+                    padding=1, use_bias=False, name="conv1")(x)
+        y = _norm(self.features, "norm1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), padding=1, use_bias=False,
+                    name="conv2")(y)
+        y = _norm(self.features, "norm2")(y)
+        if residual.shape[-1] != self.features or self.strides != 1:
+            residual = nn.Conv(
+                self.features, (1, 1), strides=(self.strides,) * 2,
+                use_bias=False, name="downsample",
+            )(residual)
+            residual = _norm(self.features, "norm_down")(residual)
+        return nn.relu(y + residual)
+
+
+class BottleNeck(nn.Module):
+    """1x1 -> 3x3 -> 1x1 residual block (expansion 4)."""
+
+    features: int
+    strides: int = 1
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        out_features = self.features * self.expansion
+        residual = x
+        y = nn.Conv(self.features, (1, 1), use_bias=False, name="conv1")(x)
+        y = _norm(self.features, "norm1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), strides=(self.strides,) * 2,
+                    padding=1, use_bias=False, name="conv2")(y)
+        y = _norm(self.features, "norm2")(y)
+        y = nn.relu(y)
+        y = nn.Conv(out_features, (1, 1), use_bias=False, name="conv3")(y)
+        y = _norm(out_features, "norm3")(y)
+        if residual.shape[-1] != out_features or self.strides != 1:
+            residual = nn.Conv(
+                out_features, (1, 1), strides=(self.strides,) * 2,
+                use_bias=False, name="downsample",
+            )(residual)
+            residual = _norm(out_features, "norm_down")(residual)
+        return nn.relu(y + residual)
+
+
+_BLOCKS = {"BasicBlock": BasicBlock, "BottleNeck": BottleNeck}
+
+
+@LAYER.register_module
+class ResHead(nn.Module):
+    """Stem: 3x3 conv + norm + relu (CIFAR-style, as the reference's)."""
+
+    in_channels: int = 3
+    features: int = 64
+
+    @nn.compact
+    def __call__(self, x):
+        if x.shape[1] == self.in_channels and x.shape[-1] != self.in_channels:
+            x = jnp.transpose(x, (0, 2, 3, 1))  # NCHW -> NHWC once on entry
+        y = nn.Conv(self.features, (3, 3), padding=1, use_bias=False,
+                    name="conv1")(x)
+        y = _norm(self.features, "norm1")(y)
+        return nn.relu(y)
+
+
+@LAYER.register_module
+class ResLayer(nn.Module):
+    """One stage: ``num_blocks`` residual blocks at a feature width."""
+
+    block_type: str
+    num_blocks: int
+    features: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        block_cls = _BLOCKS[self.block_type]
+        for i in range(self.num_blocks):
+            x = block_cls(
+                self.features,
+                strides=self.strides if i == 0 else 1,
+                name=f"block{i}",
+            )(x)
+        return x
+
+
+@LAYER.register_module
+class ResTail(nn.Module):
+    """Global average pool + linear classifier."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, name="fc")(x)
+
+
+def resnet_layer_configs(
+    block_type: str,
+    blocks_per_stage: Sequence[int],
+    num_classes: int = 10,
+    in_channels: int = 3,
+) -> list:
+    """Full layer-config list: head + one ResLayer per stage + tail."""
+    widths = [64, 128, 256, 512]
+    cfgs = [dict(layer_type="ResHead", in_channels=in_channels, features=64)]
+    for i, (n, w) in enumerate(zip(blocks_per_stage, widths)):
+        cfgs.append(
+            dict(
+                layer_type="ResLayer",
+                block_type=block_type,
+                num_blocks=n,
+                features=w,
+                strides=1 if i == 0 else 2,
+            )
+        )
+    cfgs.append(dict(layer_type="ResTail", num_classes=num_classes))
+    return cfgs
+
+
+class ResNet(nn.Module):
+    """Monolithic ResNet (reference ``ResNet``, ``layers.py:170-236``)."""
+
+    block_type: str
+    blocks_per_stage: Sequence[int]
+    num_classes: int = 10
+    in_channels: int = 3
+
+    @nn.compact
+    def __call__(self, x):
+        for cfg in resnet_layer_configs(
+            self.block_type, self.blocks_per_stage, self.num_classes,
+            self.in_channels,
+        ):
+            cfg = dict(cfg)
+            layer_type = cfg.pop("layer_type")
+            x = LAYER.get_module(layer_type)(**cfg)(x)
+        return x
+
+
+def resnet18(**kw) -> ResNet:
+    return ResNet("BasicBlock", [2, 2, 2, 2], **kw)
+
+
+def resnet34(**kw) -> ResNet:
+    return ResNet("BasicBlock", [3, 4, 6, 3], **kw)
+
+
+def resnet50(**kw) -> ResNet:
+    return ResNet("BottleNeck", [3, 4, 6, 3], **kw)
+
+
+def resnet101(**kw) -> ResNet:
+    return ResNet("BottleNeck", [3, 4, 23, 3], **kw)
+
+
+def resnet152(**kw) -> ResNet:
+    return ResNet("BottleNeck", [3, 8, 36, 3], **kw)
+
+
+__all__ = [
+    "BasicBlock",
+    "BottleNeck",
+    "ResHead",
+    "ResLayer",
+    "ResTail",
+    "ResNet",
+    "resnet_layer_configs",
+    "resnet18",
+    "resnet34",
+    "resnet50",
+    "resnet101",
+    "resnet152",
+]

@@ -1,0 +1,3 @@
+from .losses import build_loss, cross_entropy_loss, mse_loss
+
+__all__ = ["build_loss", "cross_entropy_loss", "mse_loss"]

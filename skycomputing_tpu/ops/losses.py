@@ -1,0 +1,33 @@
+"""Loss functions, registry-named after their reference torch counterparts
+(``runner/runner.py:50-52`` resolves ``loss_cfg['type']`` from ``torch.nn``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+from ..registry import LOSS
+
+
+@LOSS.register_module(name="CrossEntropyLoss")
+def cross_entropy_loss(logits, labels):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), labels
+    ).mean()
+
+
+@LOSS.register_module(name="MSELoss")
+def mse_loss(predictions, targets):
+    return jnp.mean((predictions.astype(jnp.float32) - targets) ** 2)
+
+
+def build_loss(loss_cfg: dict):
+    cfg = dict(loss_cfg)
+    name = cfg.pop("type")
+    fn = LOSS.get_module(name)
+    if cfg:
+        raise ValueError(f"loss {name} takes no extra config, got {cfg}")
+    return fn
+
+
+__all__ = ["cross_entropy_loss", "mse_loss", "build_loss"]

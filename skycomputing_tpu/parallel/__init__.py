@@ -1,0 +1,10 @@
+from .mesh import make_dp_pp_mesh, make_pipeline_mesh
+from .pipeline import PipelineModel, PipelineStats, StageRuntime
+
+__all__ = [
+    "make_dp_pp_mesh",
+    "make_pipeline_mesh",
+    "PipelineModel",
+    "PipelineStats",
+    "StageRuntime",
+]

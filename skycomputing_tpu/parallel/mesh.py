@@ -1,0 +1,39 @@
+"""Device-mesh helpers for the SPMD paths and multi-host scale-out."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+def make_pipeline_mesh(
+    num_stages: int, devices: Optional[Sequence] = None
+) -> Mesh:
+    """A 1-D ('pp',) mesh over the first ``num_stages`` devices."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) < num_stages:
+        raise ValueError(
+            f"need {num_stages} devices for the pipeline mesh, have {len(devs)}"
+        )
+    return Mesh(np.array(devs[:num_stages]), axis_names=("pp",))
+
+
+def make_dp_pp_mesh(
+    dp: int, pp: int, devices: Optional[Sequence] = None
+) -> Mesh:
+    """('dp', 'pp') mesh: data-parallel replicas of a pipeline.
+
+    Lay pp along the innermost axis so stage-to-stage ppermute rides
+    neighboring ICI links.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) < dp * pp:
+        raise ValueError(f"need {dp * pp} devices, have {len(devs)}")
+    grid = np.array(devs[: dp * pp]).reshape(dp, pp)
+    return Mesh(grid, axis_names=("dp", "pp"))
+
+
+__all__ = ["make_pipeline_mesh", "make_dp_pp_mesh"]

@@ -1,0 +1,262 @@
+"""Compiled SPMD pipeline: GPipe over a ('pp',) mesh in ONE jitted program.
+
+This is the homogeneous-cluster fast path, complementary to the host-driven
+MPMD engine in :mod:`.pipeline`:
+
+- the MPMD engine supports *unequal* stages (the allocator's whole point)
+  and re-slices without recompiling unmoved stages;
+- this SPMD engine requires uniform stages but compiles the ENTIRE training
+  step — forward, pipelined microbatch schedule, backward, optimizer — into
+  a single XLA program over a ``jax.sharding.Mesh``, with stage-to-stage
+  activation handoff as ``lax.ppermute`` over ICI neighbor links and
+  per-stage parameters sharded on the ``pp`` mesh axis (leading-axis stack).
+
+The schedule is classic GPipe fill-drain: with S stages and M microbatches
+the shard_map body scans T = M + S - 1 ticks; at tick t, stage s computes
+microbatch ``t - s`` (bubble ticks compute-and-discard).  Backward is just
+``jax.grad`` through the scan — ppermute transposes to the reverse
+permutation, so XLA derives the reverse schedule automatically; no
+distributed autograd machinery exists anywhere (the reference needed
+torch.distributed.autograd + DistributedOptimizer for this,
+``scaelum/runner/runner.py:127-139``).
+
+Non-repeated ends (embeddings / pooler / classifier) run replicated outside
+the pipelined block.  Dropout is disabled in this path (deterministic
+pipeline body); the MPMD engine handles stochastic training.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import flax.linen as nn
+
+from ..models.bert import (
+    BertEmbeddings,
+    BertLayer_Body,
+    BertLayer_Head,
+    BertLayer_Tail,
+    BertPooler,
+    BertTailForClassification,
+)
+from ..models.bert_config import BertConfig
+
+
+class EncoderStage(nn.Module):
+    """``units`` encoder trios = one uniform pipeline stage."""
+
+    config: Any
+    units: int
+
+    @nn.compact
+    def __call__(self, hidden, mask):
+        for u in range(self.units):
+            hidden, mask = nn.remat(BertLayer_Head)(
+                self.config, True, name=f"head_{u}"
+            )(hidden, mask)
+            inter, attn, mask = BertLayer_Body(
+                self.config, True, name=f"body_{u}"
+            )(hidden, mask)
+            hidden, mask = BertLayer_Tail(
+                self.config, True, name=f"tail_{u}"
+            )(inter, attn, mask)
+        return hidden, mask
+
+
+class CompiledBertPipeline:
+    """BERT classifier with the encoder pipelined across a ('pp',) mesh."""
+
+    def __init__(
+        self,
+        config: Any,
+        mesh: Mesh,
+        units_per_stage: int,
+        num_classes: int = 3,
+        num_microbatches: Optional[int] = None,
+        learning_rate: float = 1e-3,
+    ):
+        self.cfg = BertConfig.from_dict(config)
+        self.mesh = mesh
+        self.num_stages = int(mesh.shape["pp"])
+        self.units_per_stage = units_per_stage
+        self.num_classes = num_classes
+        self.num_microbatches = num_microbatches or self.num_stages
+        self.optimizer = optax.sgd(learning_rate)
+
+        cfg_dict = self.cfg.to_dict()
+        self.embeddings = BertEmbeddings(cfg_dict, deterministic=True)
+        self.stage = EncoderStage(cfg_dict, units_per_stage)
+        self.pooler = BertPooler(cfg_dict, deterministic=True)
+        self.classifier = BertTailForClassification(
+            hidden_dropout_prob=self.cfg.hidden_dropout_prob,
+            hidden_size=self.cfg.hidden_size,
+            num_classes=num_classes,
+            deterministic=True,
+            dtype=self.cfg.dtype,
+        )
+
+        self._stage_spec = P("pp")
+        self._repl_spec = P()
+        self.param_shardings: Optional[Dict] = None
+        self._train_step = None
+
+    # --- init ----------------------------------------------------------------
+    def init(self, rng: jax.Array, input_ids, token_type_ids, attention_mask):
+        """Initialize params: stage params stacked on a leading 'pp' axis."""
+        k_embed, k_stage, k_pool, k_cls = jax.random.split(rng, 4)
+        embed_vars = self.embeddings.init(
+            {"params": k_embed}, input_ids, token_type_ids, attention_mask
+        )
+        hidden, mask4 = self.embeddings.apply(
+            embed_vars, input_ids, token_type_ids, attention_mask
+        )
+
+        def init_one_stage(key):
+            return self.stage.init({"params": key}, hidden, mask4)["params"]
+
+        stage_keys = jax.random.split(k_stage, self.num_stages)
+        stages = jax.vmap(init_one_stage)(stage_keys)  # leading dim = S
+
+        pooler_vars = self.pooler.init({"params": k_pool}, hidden, mask4)
+        pooled = self.pooler.apply(pooler_vars, hidden, mask4)
+        cls_vars = self.classifier.init({"params": k_cls}, pooled)
+
+        params = {
+            "embeddings": embed_vars["params"],
+            "stages": stages,
+            "pooler": pooler_vars["params"],
+            "classifier": cls_vars["params"],
+        }
+        self.param_shardings = {
+            "embeddings": NamedSharding(self.mesh, self._repl_spec),
+            "stages": jax.tree_util.tree_map(
+                lambda _: NamedSharding(self.mesh, self._stage_spec),
+                stages,
+            ),
+            "pooler": NamedSharding(self.mesh, self._repl_spec),
+            "classifier": NamedSharding(self.mesh, self._repl_spec),
+        }
+        params = jax.device_put(params, self.param_shardings)
+        return params
+
+    def init_opt_state(self, params):
+        # any momentum/trace buffers are shaped like params and inherit
+        # their shardings (params are already placed by init())
+        return self.optimizer.init(params)
+
+    # --- the pipelined encoder ----------------------------------------------
+    def _pipelined_encoder(self, stage_params, hidden_mb, mask_mb):
+        """shard_map GPipe: [M, mb, L, H] -> [M, mb, L, H]."""
+        S = self.num_stages
+        M = self.num_microbatches
+        stage_mod = self.stage
+
+        def body(local_stage_params, hidden_mb, mask_mb):
+            # local leaves have leading dim 1 (this device's stage)
+            params = jax.tree_util.tree_map(
+                lambda x: x[0], local_stage_params
+            )
+            idx = lax.axis_index("pp")
+            fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+
+            state = jnp.zeros_like(hidden_mb[0])
+            outputs = jnp.zeros_like(hidden_mb)
+
+            def tick(carry, t):
+                state, outputs = carry
+                recv = lax.ppermute(state, "pp", fwd_perm)
+                feed = hidden_mb[jnp.clip(t, 0, M - 1)]
+                inp = jnp.where(idx == 0, feed, recv)
+                mb_idx = jnp.clip(t - idx, 0, M - 1)
+                out, _ = stage_mod.apply(
+                    {"params": params}, inp, mask_mb[mb_idx]
+                )
+                # last stage records its finished microbatch; earlier
+                # (bubble) writes land on index 0 and are overwritten at
+                # t == S-1 by the real microbatch 0
+                w = jnp.clip(t - (S - 1), 0, M - 1)
+                outputs = lax.dynamic_update_index_in_dim(
+                    outputs, out, w, axis=0
+                )
+                return (out, outputs), None
+
+            (_, outputs), _ = lax.scan(
+                tick, (state, outputs), jnp.arange(M + S - 1)
+            )
+            return outputs
+
+        out = jax.shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(self._stage_spec, P(), P()),
+            out_specs=P("pp"),
+            check_vma=False,
+        )(stage_params, hidden_mb, mask_mb)
+        # out_specs=P('pp') concatenates per-stage [M, ...] buffers along
+        # axis 0 -> [S*M, ...]; only the last stage's block holds the
+        # completed microbatches
+        return out[-M:]
+
+    # --- full model ----------------------------------------------------------
+    def _logits(self, params, input_ids, token_type_ids, attention_mask):
+        M = self.num_microbatches
+        hidden, mask4 = self.embeddings.apply(
+            {"params": params["embeddings"]},
+            input_ids, token_type_ids, attention_mask,
+        )
+        B = hidden.shape[0]
+        if B % M != 0:
+            raise ValueError(f"batch {B} not divisible by microbatches {M}")
+        hidden_mb = hidden.reshape(M, B // M, *hidden.shape[1:])
+        mask_mb = mask4.reshape(M, B // M, *mask4.shape[1:])
+
+        encoded = self._pipelined_encoder(params["stages"], hidden_mb, mask_mb)
+        encoded = encoded.reshape(B, *encoded.shape[2:])
+
+        pooled = self.pooler.apply(
+            {"params": params["pooler"]}, encoded, mask4
+        )
+        return self.classifier.apply(
+            {"params": params["classifier"]}, pooled
+        )
+
+    def loss(self, params, batch, labels):
+        input_ids, token_type_ids, attention_mask = batch
+        logits = self._logits(
+            params, input_ids, token_type_ids, attention_mask
+        )
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), labels
+        ).mean()
+
+    # --- training ------------------------------------------------------------
+    def make_train_step(self):
+        """The FULL train step — grad + update — as one jitted program."""
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def train_step(params, opt_state, batch, labels):
+            loss, grads = jax.value_and_grad(self.loss)(params, batch, labels)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params
+            )
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._train_step = train_step
+        return train_step
+
+    def train_step(self, params, opt_state, batch, labels):
+        if self._train_step is None:
+            self.make_train_step()
+        return self._train_step(params, opt_state, batch, labels)
+
+
+__all__ = ["CompiledBertPipeline", "EncoderStage"]

@@ -1,0 +1,11 @@
+from .hooks import Hook
+from .hooks_collection import CheckpointHook, DistributedTimerHelperHook, StopHook
+from .runner import Runner
+
+__all__ = [
+    "Hook",
+    "Runner",
+    "CheckpointHook",
+    "DistributedTimerHelperHook",
+    "StopHook",
+]

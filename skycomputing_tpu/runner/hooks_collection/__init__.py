@@ -1,0 +1,5 @@
+from .checkpoint_hook import CheckpointHook
+from .stop_hook import StopHook
+from .timer_hook import DistributedTimerHelperHook
+
+__all__ = ["CheckpointHook", "StopHook", "DistributedTimerHelperHook"]

@@ -1,0 +1,59 @@
+"""Partition-independent checkpointing.
+
+Parity with ``scaelum/runner/hooks_collection/checkpoint_hook.py:14-74``:
+before_run optionally restores a whole-model checkpoint into the parameter
+server and scatters per-stage slices; after every N epochs gathers all
+stages' weights into the parameter server and writes ``epoch_{n}.msgpack``.
+Because the store is layer-indexed, the checkpoint restores correctly under
+a *different* allocation than it was saved with.  The reference's restore
+path was latently broken (missing ``_move_module_to_cuda``,
+``rpc_module.py:64,93``); the intended behavior is implemented.
+"""
+
+from __future__ import annotations
+
+import os
+import os.path as osp
+from typing import Optional
+
+from ...registry import HOOKS
+from ..hooks import Hook
+
+
+@HOOKS.register_module
+class CheckpointHook(Hook):
+    def __init__(
+        self,
+        load_checkpoint_from: Optional[str] = None,
+        save_path: Optional[str] = None,
+        save_interval: Optional[int] = None,
+    ):
+        self._load_checkpoint_from = load_checkpoint_from
+        self._save_path = save_path
+        self._save_interval = save_interval
+
+    def before_run(self, runner):
+        if self._load_checkpoint_from:
+            runner.parameter_server.load_weights_from_file(
+                self._load_checkpoint_from
+            )
+            runner.model.load_from_parameter_server()
+            runner.logger.info(
+                f"restored checkpoint from {self._load_checkpoint_from}"
+            )
+
+    def after_epoch(self, runner):
+        if not self._save_path or not self._save_interval:
+            return
+        if not self.every_n_epochs(runner, self._save_interval):
+            return
+        os.makedirs(self._save_path, exist_ok=True)
+        runner.model.sync_to_parameter_server()
+        # after_epoch runs after the runner increments epoch, so runner.epoch
+        # is already the 1-based count of completed epochs
+        path = osp.join(self._save_path, f"epoch_{runner.epoch}.msgpack")
+        runner.parameter_server.save_weights_to_file(path)
+        runner.logger.info(f"saved checkpoint to {path}")
+
+
+__all__ = ["CheckpointHook"]

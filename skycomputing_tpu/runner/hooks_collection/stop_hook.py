@@ -1,0 +1,50 @@
+"""Out-of-band cooperative stop.
+
+Parity with ``scaelum/runner/hooks_collection/stop_hook.py:13-38``: after
+each iteration, poll a stop-flag file that an external process may write.
+The reference's stop path raised (it poked ``runner.max_iters``/
+``max_epochs`` through a broken property, ``stop_hook.py:23-24``); here the
+runner exposes ``request_stop()`` and the hook uses it.
+"""
+
+from __future__ import annotations
+
+import os
+import os.path as osp
+
+from ...registry import HOOKS
+from ..hooks import Hook
+
+STOP_FILENAME = "stop_flag.txt"
+
+
+@HOOKS.register_module
+class StopHook(Hook):
+    def __init__(self, root: str):
+        self._root = root
+        os.makedirs(root, exist_ok=True)
+
+    @property
+    def _flag_path(self) -> str:
+        return osp.join(self._root, STOP_FILENAME)
+
+    def before_run(self, runner):
+        # stale flag from a previous run must not kill this one
+        if osp.exists(self._flag_path):
+            os.remove(self._flag_path)
+
+    def after_iter(self, runner):
+        if osp.exists(self._flag_path):
+            with open(self._flag_path) as fh:
+                if fh.read().strip() == "1":
+                    runner.logger.info("stop flag detected — stopping run")
+                    runner.request_stop()
+
+    @staticmethod
+    def stop(root: str) -> None:
+        """External API: request a running trainer to stop."""
+        with open(osp.join(root, STOP_FILENAME), "w") as fh:
+            fh.write("1")
+
+
+__all__ = ["StopHook"]

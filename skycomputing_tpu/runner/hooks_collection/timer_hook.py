@@ -1,0 +1,19 @@
+"""Timer hygiene hook (parity:
+``hooks_collection/distributed_timer_helper_hook.py:11-16``)."""
+
+from __future__ import annotations
+
+from ...registry import HOOKS
+from ..hooks import Hook
+
+
+@HOOKS.register_module
+class DistributedTimerHelperHook(Hook):
+    def before_run(self, runner):
+        runner.timer.clean()
+
+    def after_run(self, runner):
+        runner.timer.clean()
+
+
+__all__ = ["DistributedTimerHelperHook"]

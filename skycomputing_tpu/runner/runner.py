@@ -1,0 +1,167 @@
+"""The training loop.
+
+Parity with ``scaelum/runner/runner.py:15-156``: epoch/iter loop over a
+dataloader with hook dispatch and per-phase wall-clock logging.  The
+reference's per-iteration work — RPC pipeline forward, host-side loss,
+``dist_autograd.backward``, ``DistributedOptimizer.step`` — collapses into
+``PipelineModel.train_step`` (compiled per-stage programs + host-threaded
+cotangents).  Reference bugs fixed rather than ported: the ``max_epochs``
+property typo (``runner.py:83-85``) and the ``>`` off-by-one in the max-iter
+check (``runner.py:119``) which ran max_iters+1 iterations.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+
+from ..dynamics import ParameterServer, WorkerManager
+from ..ops import build_loss
+from ..parallel import PipelineModel
+from ..utils import DistributedTimer, Logger, PhaseTimer
+from .hooks import Hook
+
+
+class Runner:
+    def __init__(
+        self,
+        model: PipelineModel,
+        parameter_server: ParameterServer,
+        worker_manager: WorkerManager,
+        max_epochs: int,
+        max_iters: int,
+        loss_cfg: Optional[Dict] = None,
+        timer_cfg: Optional[Dict] = None,
+        logging_cfg: Optional[Dict] = None,
+        seed: int = 0,
+    ):
+        self.model = model
+        self.parameter_server = parameter_server
+        self.worker_manager = worker_manager
+
+        self._hooks: List[Hook] = []
+        self._epoch = 0
+        self._iter = 0
+        self._inner_iter = 0
+        self._max_epochs = max_epochs
+        self._max_iters = max_iters
+        self._stop = False
+        self._rng = jax.random.key(seed)
+
+        self._logger = Logger(**(logging_cfg or {}))
+        self._timer = DistributedTimer(**(timer_cfg or {}))
+        self.phase_timer = PhaseTimer()
+        self.data_loader = None
+
+        if loss_cfg is not None:
+            # the model already owns a loss; loss_cfg overrides it (and
+            # recompiles the loss program so stale traces can't survive)
+            self.model.set_loss_fn(build_loss(loss_cfg))
+
+    # --- state --------------------------------------------------------------
+    @property
+    def hooks(self) -> List[Hook]:
+        return self._hooks
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    @epoch.setter
+    def epoch(self, value: int) -> None:
+        self._epoch = value
+
+    @property
+    def iter(self) -> int:
+        return self._iter
+
+    @iter.setter
+    def iter(self, value: int) -> None:
+        self._iter = value
+
+    @property
+    def inner_iter(self) -> int:
+        return self._inner_iter
+
+    @property
+    def max_epochs(self) -> int:
+        return self._max_epochs
+
+    @property
+    def max_iters(self) -> int:
+        return self._max_iters
+
+    # legacy singular alias (reference exposed ``max_iter``)
+    @property
+    def max_iter(self) -> int:
+        return self._max_iters
+
+    @property
+    def timer(self) -> DistributedTimer:
+        return self._timer
+
+    @property
+    def logger(self) -> Logger:
+        return self._logger
+
+    def request_stop(self) -> None:
+        """Cooperative stop: finishes the current iteration then exits."""
+        self._stop = True
+
+    # --- hooks --------------------------------------------------------------
+    def register_hook(self, hook: Hook) -> None:
+        assert isinstance(hook, Hook)
+        self._hooks.append(hook)
+
+    def _call_hook(self, fn_name: str) -> None:
+        for hook in self._hooks:
+            getattr(hook, fn_name)(self)
+
+    # --- training -----------------------------------------------------------
+    def train(self, data_loader) -> None:
+        self.data_loader = data_loader
+        self.model.train(True)
+        self._call_hook("before_run")
+
+        while self._epoch < self._max_epochs and not self._stop:
+            self._call_hook("before_train_epoch")
+            self._inner_iter = 0
+
+            for data, labels in data_loader:
+                if self._iter >= self._max_iters or self._stop:
+                    break
+
+                self._logger.info(
+                    f"epoch: {self._epoch}, iter: {self._iter}"
+                )
+                self._call_hook("before_train_iter")
+
+                self._rng, step_rng = jax.random.split(self._rng)
+                self._timer.add_timestamp()
+                loss = self.model.train_step(data, labels, rng=step_rng)
+                self._timer.add_timestamp()
+
+                stats = self.model.stats
+                self.phase_timer.record("forward", stats.forward_s)
+                self.phase_timer.record("backward", stats.backward_s)
+                self.phase_timer.record("step", stats.step_s)
+                self._logger.info(
+                    f"loss: {loss:.6f} | forward time: {stats.forward_s:.4f} | "
+                    f"backward time: {stats.backward_s:.4f} | "
+                    f"step time: {stats.step_s:.4f}"
+                )
+
+                self._iter += 1
+                self._inner_iter += 1
+                self._call_hook("after_train_iter")
+
+            self._epoch += 1
+            self._call_hook("after_train_epoch")
+            if self._iter >= self._max_iters:
+                break
+
+        self._call_hook("after_run")
+
+
+__all__ = ["Runner"]

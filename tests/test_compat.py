@@ -1,0 +1,12 @@
+"""The ``scaelum`` alias exposes the reference-familiar API paths."""
+
+
+def test_scaelum_alias_imports():
+    import scaelum
+    from scaelum import Logger, WorkerManager, load_config  # noqa: F401
+    from scaelum.dynamics import Allocator, ParameterServer  # noqa: F401
+    from scaelum.model import BertLayer_Head  # noqa: F401
+    from scaelum.runner import Hook, Runner  # noqa: F401
+    from scaelum.stimulator import Stimulator  # noqa: F401
+
+    assert scaelum.__version__

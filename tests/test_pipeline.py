@@ -1,0 +1,168 @@
+"""Pipeline engine: multi-stage training on the 8-device CPU mesh."""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from skycomputing_tpu.dynamics import Allocator, ParameterServer, WorkerManager
+from skycomputing_tpu.models import bert_config, bert_layer_configs
+from skycomputing_tpu.ops import cross_entropy_loss
+from skycomputing_tpu.parallel import PipelineModel
+
+
+def build_pipeline(devices, n_workers=4, units=2, num_microbatches=1,
+                   batch=8, seq=16, slowdowns=None, seed=0):
+    cfg = bert_config("tiny", dtype="float32", hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)
+    model_cfg = bert_layer_configs(cfg, num_encoder_units=units,
+                                   num_classes=3, deterministic=True)
+
+    wm = WorkerManager()
+    wm.load_worker_pool_from_config(
+        [
+            dict(
+                name=f"node-{i}",
+                device_config=dict(device_index=i),
+                extra_config=dict(
+                    slowdown=(slowdowns[i] if slowdowns else 1.0)
+                ),
+            )
+            for i in range(n_workers)
+        ]
+    )
+
+    class _NoProfile:
+        def benchmark(self):
+            raise AssertionError("even allocation must not profile")
+
+    Allocator(model_cfg, wm, _NoProfile(), _NoProfile()).even_allocate()
+
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(5, 1024, size=(batch, seq)).astype(np.int32)
+    types = np.zeros_like(ids)
+    mask = np.ones_like(ids)
+    labels = rng.integers(0, 3, size=(batch,)).astype(np.int32)
+
+    ps = ParameterServer(model_cfg, example_inputs=(ids, types, mask),
+                         rng=jax.random.key(seed))
+    model = PipelineModel(
+        wm, ps, optax.sgd(1e-2), cross_entropy_loss,
+        devices=devices, num_microbatches=num_microbatches,
+    )
+    return model, (ids, types, mask), labels, ps
+
+
+def test_stages_live_on_distinct_devices(devices):
+    model, *_ = build_pipeline(devices, n_workers=4)
+    stage_devices = [s.device for s in model.stages]
+    assert len(set(stage_devices)) == 4
+    # params actually committed to those devices
+    for stage in model.stages:
+        leaf = jax.tree_util.tree_leaves(stage.params)[0]
+        assert leaf.devices() == {stage.device}
+
+
+def test_forward_matches_single_device_reference(devices):
+    model, data, _, ps = build_pipeline(devices, n_workers=4)
+    logits = np.asarray(model.forward(data))
+    # reference: the same params applied as one monolithic stack
+    ref = np.asarray(ps.stack.apply(ps.params, *data))
+    np.testing.assert_allclose(logits, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_train_step_decreases_loss(devices):
+    model, data, labels, _ = build_pipeline(devices, n_workers=4)
+    losses = [model.train_step(data, labels, rng=jax.random.key(i))
+              for i in range(8)]
+    assert losses[-1] < losses[0], losses
+    assert model.stats.forward_s > 0
+    assert model.stats.backward_s > 0
+
+
+def test_pipeline_grads_match_monolithic(devices):
+    """Per-stage remat backward == one jax.grad over the whole model."""
+    model, data, labels, ps = build_pipeline(devices, n_workers=3)
+
+    # monolithic reference grads (before any update)
+    def loss_fn(params_list):
+        logits = ps.stack.apply(params_list, *data)
+        return cross_entropy_loss(logits, labels)
+
+    ref_grads = jax.grad(loss_fn)(ps.params)
+
+    model.train_step(data, labels, rng=jax.random.key(0))
+    # recompute pipeline grads by comparing updated params to originals:
+    # sgd(lr) => delta = -lr * grad
+    lr = 1e-2
+    cursor = 0
+    for stage in model.stages:
+        for li, layer_params in enumerate(stage.get_state_dict()):
+            ref = ref_grads[cursor]
+            for (path_new, new), (path_ref, g) in zip(
+                jax.tree_util.tree_leaves_with_path(layer_params),
+                jax.tree_util.tree_leaves_with_path(ref),
+            ):
+                assert path_new == path_ref
+                orig = jax.tree_util.tree_leaves(ps.params[cursor])[
+                    [p for p, _ in
+                     jax.tree_util.tree_leaves_with_path(ps.params[cursor])
+                     ].index(path_new)
+                ]
+                delta = np.asarray(new) - np.asarray(orig)
+                np.testing.assert_allclose(
+                    delta, -lr * np.asarray(g), rtol=2e-3, atol=2e-6,
+                )
+            cursor += 1
+    assert cursor == ps.num_layers
+
+
+def test_microbatched_equals_full_batch_grads(devices):
+    """M=4 gradient accumulation must equal the M=1 update (no dropout)."""
+    m1, data, labels, _ = build_pipeline(devices, n_workers=3,
+                                         num_microbatches=1, seed=3)
+    m4, *_ = build_pipeline(devices, n_workers=3, num_microbatches=4, seed=3)
+    l1 = m1.train_step(data, labels, rng=jax.random.key(0))
+    l4 = m4.train_step(data, labels, rng=jax.random.key(0))
+    assert l1 == pytest.approx(l4, rel=1e-5)
+    for s1, s4 in zip(m1.stages, m4.stages):
+        for a, b in zip(
+            jax.tree_util.tree_leaves(s1.params),
+            jax.tree_util.tree_leaves(s4.params),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+            )
+
+
+def test_checkpoint_survives_reallocation(devices, tmp_path):
+    """Train 4-way, checkpoint, restore into a 2-way pipeline, same logits."""
+    model, data, labels, ps = build_pipeline(devices, n_workers=4)
+    model.train_step(data, labels, rng=jax.random.key(0))
+    model.sync_to_parameter_server()
+    ckpt = str(tmp_path / "ckpt.msgpack")
+    ps.save_weights_to_file(ckpt)
+    logits_before = np.asarray(model.forward(data))
+
+    # new cluster shape: 2 workers
+    model2, _, _, ps2 = build_pipeline(devices, n_workers=2)
+    ps2.load_weights_from_file(ckpt)
+    model2.load_from_parameter_server()
+    logits_after = np.asarray(model2.forward(data))
+    np.testing.assert_allclose(logits_before, logits_after, rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_slowdown_inflates_step_time(devices):
+    fast, data, labels, _ = build_pipeline(devices, n_workers=2, units=1)
+    slow, *_ = build_pipeline(devices, n_workers=2, units=1,
+                              slowdowns=[8.0, 8.0])
+    fast.train_step(data, labels, rng=jax.random.key(0))  # warm compile
+    slow.train_step(data, labels, rng=jax.random.key(0))
+    import time
+
+    t0 = time.perf_counter(); fast.train_step(data, labels, rng=jax.random.key(1))
+    t_fast = time.perf_counter() - t0
+    t0 = time.perf_counter(); slow.train_step(data, labels, rng=jax.random.key(1))
+    t_slow = time.perf_counter() - t0
+    assert t_slow > t_fast * 2, (t_fast, t_slow)

@@ -1,0 +1,55 @@
+"""ResNet zoo: decomposed units, monolithic constructors, pipeline compat."""
+
+import jax
+import numpy as np
+import optax
+
+from skycomputing_tpu.builder import build_layer_stack
+from skycomputing_tpu.models import resnet18, resnet_layer_configs
+
+
+def test_resnet_layer_configs_build_and_run():
+    cfgs = resnet_layer_configs("BasicBlock", [1, 1, 1, 1], num_classes=10)
+    stack = build_layer_stack(cfgs)
+    x = np.random.default_rng(0).normal(size=(2, 3, 32, 32)).astype(np.float32)
+    params = stack.init(jax.random.key(0), x)
+    logits = stack.apply(params, x)
+    assert logits.shape == (2, 10)
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_monolithic_resnet18():
+    model = resnet18(num_classes=10)
+    x = np.random.default_rng(0).normal(size=(2, 3, 32, 32)).astype(np.float32)
+    variables = model.init(jax.random.key(0), x)
+    logits = model.apply(variables, x)
+    assert logits.shape == (2, 10)
+
+
+def test_resnet_pipeline_trains(devices):
+    """The CNN zoo plugs into the same pipeline engine as BERT."""
+    from skycomputing_tpu.dynamics import (
+        Allocator,
+        ParameterServer,
+        WorkerManager,
+    )
+    from skycomputing_tpu.ops import cross_entropy_loss
+    from skycomputing_tpu.parallel import PipelineModel
+
+    cfgs = resnet_layer_configs("BasicBlock", [1, 1, 1, 1], num_classes=10)
+    wm = WorkerManager()
+    wm.load_worker_pool_from_config(
+        [dict(name=f"n{i}", device_config=dict(device_index=i),
+              extra_config={}) for i in range(3)]
+    )
+    Allocator(cfgs, wm, None, None).even_allocate()
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 3, 32, 32)).astype(np.float32)
+    labels = rng.integers(0, 10, size=(8,)).astype(np.int32)
+    ps = ParameterServer(cfgs, example_inputs=(x,))
+    model = PipelineModel(wm, ps, optax.sgd(1e-2), cross_entropy_loss,
+                          devices=devices)
+    losses = [model.train_step((x,), labels, rng=jax.random.key(i))
+              for i in range(4)]
+    assert losses[-1] < losses[0], losses

@@ -1,0 +1,180 @@
+"""Runner + hooks: full train loop, checkpointing, stop file."""
+
+import os.path as osp
+
+import jax
+import numpy as np
+import optax
+
+from skycomputing_tpu.builder import build_hook
+from skycomputing_tpu.dataset import DataLoader, RandomBertDataset
+from skycomputing_tpu.dynamics import Allocator, ParameterServer, WorkerManager
+from skycomputing_tpu.models import bert_config, bert_layer_configs
+from skycomputing_tpu.ops import cross_entropy_loss
+from skycomputing_tpu.parallel import PipelineModel
+from skycomputing_tpu.runner import (
+    CheckpointHook,
+    DistributedTimerHelperHook,
+    Hook,
+    Runner,
+    StopHook,
+)
+
+
+def build_world(devices, n_workers=3, units=2, seed=0):
+    cfg = bert_config("tiny", dtype="float32", hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)
+    model_cfg = bert_layer_configs(cfg, num_encoder_units=units,
+                                   num_classes=3, deterministic=True)
+    wm = WorkerManager()
+    wm.load_worker_pool_from_config(
+        [dict(name=f"n{i}", device_config=dict(device_index=i),
+              extra_config={}) for i in range(n_workers)]
+    )
+    Allocator(model_cfg, wm, None, None).even_allocate()
+
+    ds = RandomBertDataset(num_samples=64, max_seq_length=16,
+                           vocab_size=1024, seed=seed)
+    loader = DataLoader(ds, batch_size=8, shuffle=False)
+    (ids, mask, segs), _ = next(iter(loader))
+    ps = ParameterServer(model_cfg, example_inputs=(ids, segs, mask),
+                         rng=jax.random.key(seed))
+    model = PipelineModel(wm, ps, optax.sgd(1e-2), cross_entropy_loss,
+                          devices=devices)
+    return model, ps, wm, loader
+
+
+class _BatchAdapter:
+    """RandomBertDataset yields (ids, mask, segs); BERT wants (ids, segs, mask)."""
+
+    def __init__(self, loader):
+        self._loader = loader
+
+    def __len__(self):
+        return len(self._loader)
+
+    def __iter__(self):
+        for (ids, mask, segs), labels in self._loader:
+            yield (ids, segs, mask), labels
+
+
+def test_runner_trains_and_calls_hooks(devices):
+    model, ps, wm, loader = build_world(devices)
+    runner = Runner(model, ps, wm, max_epochs=2, max_iters=6)
+
+    calls = []
+
+    class Recorder(Hook):
+        def before_run(self, r):
+            calls.append("before_run")
+
+        def after_iter(self, r):
+            calls.append("iter")
+
+        def after_run(self, r):
+            calls.append("after_run")
+
+    runner.register_hook(Recorder())
+    runner.register_hook(DistributedTimerHelperHook())
+    runner.train(_BatchAdapter(loader))
+
+    assert runner.iter == 6  # max_iters respected exactly (no off-by-one)
+    assert calls[0] == "before_run" and calls[-1] == "after_run"
+    assert calls.count("iter") == 6
+    assert runner.phase_timer.mean("forward") > 0
+
+
+def test_stop_hook_interrupts_training(devices, tmp_path):
+    model, ps, wm, loader = build_world(devices)
+    runner = Runner(model, ps, wm, max_epochs=10, max_iters=100)
+    root = str(tmp_path)
+    runner.register_hook(StopHook(root))
+
+    class StopAfter3(Hook):
+        def after_iter(self, r):
+            if r.iter == 3:
+                StopHook.stop(root)
+
+    runner.register_hook(StopAfter3())
+    runner.train(_BatchAdapter(loader))
+    assert runner.iter == 4  # iter 3 wrote the flag; iter 4 saw it and stopped
+
+
+def test_checkpoint_hook_saves_and_restores(devices, tmp_path):
+    model, ps, wm, loader = build_world(devices, seed=1)
+    save_dir = str(tmp_path / "ckpts")
+    runner = Runner(model, ps, wm, max_epochs=1, max_iters=3)
+    runner.register_hook(
+        CheckpointHook(save_path=save_dir, save_interval=1)
+    )
+    runner.train(_BatchAdapter(loader))
+    ckpt = osp.join(save_dir, "epoch_1.msgpack")
+    assert osp.exists(ckpt)
+
+    # restore into a differently-partitioned world (2 workers, not 3)
+    model2, ps2, wm2, loader2 = build_world(devices, n_workers=2, seed=2)
+    runner2 = Runner(model2, ps2, wm2, max_epochs=0, max_iters=0)
+    runner2.register_hook(CheckpointHook(load_checkpoint_from=ckpt))
+    runner2.train(_BatchAdapter(loader2))
+
+    batch = next(iter(_BatchAdapter(loader)))
+    np.testing.assert_allclose(
+        np.asarray(model.forward(batch[0])),
+        np.asarray(model2.forward(batch[0])),
+        rtol=2e-4, atol=2e-5,
+    )
+
+
+def test_checkpoint_every_n_epochs_exact(devices, tmp_path):
+    """save_interval=2, max_epochs=4 -> epoch_2 and epoch_4, not 1/3."""
+    model, ps, wm, loader = build_world(devices)
+    save_dir = str(tmp_path / "ckpts")
+    runner = Runner(model, ps, wm, max_epochs=4, max_iters=1000)
+    runner.register_hook(CheckpointHook(save_path=save_dir, save_interval=2))
+    # 2 iters per epoch keeps this fast
+    short = list(_BatchAdapter(loader))[:2]
+    runner.train(short)
+    import os
+
+    saved = sorted(os.listdir(save_dir))
+    assert saved == ["epoch_2.msgpack", "epoch_4.msgpack"], saved
+
+
+def test_eval_mode_forward_is_deterministic(devices):
+    """With dropout active, train() toggles stochastic vs deterministic."""
+    import optax
+
+    from skycomputing_tpu.models import bert_config, bert_layer_configs
+    from skycomputing_tpu.ops import cross_entropy_loss
+    from skycomputing_tpu.parallel import PipelineModel
+
+    cfg = bert_config("tiny", dtype="float32")  # dropout prob 0.1, live
+    model_cfg = bert_layer_configs(cfg, num_encoder_units=1, num_classes=3,
+                                   deterministic=False)
+    wm = WorkerManager()
+    wm.load_worker_pool_from_config(
+        [dict(name=f"n{i}", device_config=dict(device_index=i),
+              extra_config={}) for i in range(2)]
+    )
+    Allocator(model_cfg, wm, None, None).even_allocate()
+    ids = np.ones((2, 8), np.int32)
+    ps = ParameterServer(model_cfg, example_inputs=(ids, ids * 0, ids * 0 + 1))
+    model = PipelineModel(wm, ps, optax.sgd(1e-2), cross_entropy_loss,
+                          devices=devices)
+
+    model.train(True)
+    a = np.asarray(model.forward((ids, ids * 0, ids * 0 + 1),
+                                 rng=jax.random.key(1)))
+    b = np.asarray(model.forward((ids, ids * 0, ids * 0 + 1),
+                                 rng=jax.random.key(2)))
+    assert not np.allclose(a, b)  # dropout active in train mode
+
+    model.train(False)
+    c = np.asarray(model.forward((ids, ids * 0, ids * 0 + 1)))
+    d = np.asarray(model.forward((ids, ids * 0, ids * 0 + 1)))
+    np.testing.assert_array_equal(c, d)  # eval mode: no dropout rng
+
+
+def test_build_hook_from_registry(tmp_path):
+    hook = build_hook(dict(type="StopHook", root=str(tmp_path)))
+    assert isinstance(hook, StopHook)
