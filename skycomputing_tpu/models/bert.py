@@ -88,6 +88,12 @@ class BertEmbeddings(nn.Module):
         ext_mask = (1.0 - ext_mask) * -10000.0
 
         seq_length = input_ids.shape[1]
+        if seq_length > cfg.max_position_embeddings:
+            raise ValueError(
+                f"sequence length {seq_length} exceeds "
+                f"max_position_embeddings={cfg.max_position_embeddings}; "
+                f"out-of-range position lookups produce NaNs"
+            )
         position_ids = jnp.arange(seq_length, dtype=jnp.int32)[None, :]
 
         word = nn.Embed(
@@ -138,6 +144,23 @@ class BertSelfAttention(nn.Module):
         q = split_heads(_dense(cfg, cfg.hidden_size, "query")(hidden_states))
         k = split_heads(_dense(cfg, cfg.hidden_size, "key")(hidden_states))
         v = split_heads(_dense(cfg, cfg.hidden_size, "value")(hidden_states))
+
+        seq_len = hidden_states.shape[1]
+        if (
+            getattr(cfg, "use_flash_attention", False)
+            and (cfg.attention_probs_dropout_prob == 0.0 or self.deterministic)
+            # the kernel tiles the sequence in 128-token blocks; fall back
+            # to the einsum path for lengths it cannot tile
+            and (seq_len <= 128 or seq_len % 128 == 0)
+        ):
+            # fused pallas path: bias is the per-token additive mask row
+            from ..ops.flash_attention import flash_attention
+
+            bias = attention_mask[:, 0, 0, :]
+            context = flash_attention(q, k, v, bias)
+            return context.reshape(
+                context.shape[0], context.shape[1], cfg.hidden_size
+            )
 
         scores = jnp.einsum("blhd,bmhd->bhlm", q, k) / jnp.sqrt(
             jnp.asarray(head_dim, dtype=dtype)

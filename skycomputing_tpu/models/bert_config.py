@@ -28,6 +28,7 @@ class BertConfig:
         initializer_range: float = 0.02,
         output_all_encoded_layers: bool = False,
         dtype: str = "bfloat16",
+        use_flash_attention: bool = False,
     ):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -42,6 +43,9 @@ class BertConfig:
         self.initializer_range = initializer_range
         self.output_all_encoded_layers = output_all_encoded_layers
         self.dtype = dtype
+        # pallas fused attention (ops/flash_attention.py); only takes effect
+        # when attention dropout is off or the module is deterministic
+        self.use_flash_attention = use_flash_attention
 
     @classmethod
     def from_dict(cls, data) -> "BertConfig":

@@ -1,3 +1,4 @@
+from .flash_attention import flash_attention
 from .losses import build_loss, cross_entropy_loss, mse_loss
 
-__all__ = ["build_loss", "cross_entropy_loss", "mse_loss"]
+__all__ = ["build_loss", "cross_entropy_loss", "mse_loss", "flash_attention"]

@@ -1,5 +1,10 @@
 from .mesh import make_dp_pp_mesh, make_pipeline_mesh
-from .pipeline import PipelineModel, PipelineStats, StageRuntime
+from .pipeline import (
+    PipelineModel,
+    PipelineStats,
+    StageRuntime,
+    clear_program_cache,
+)
 
 __all__ = [
     "make_dp_pp_mesh",
@@ -7,4 +12,5 @@ __all__ = [
     "PipelineModel",
     "PipelineStats",
     "StageRuntime",
+    "clear_program_cache",
 ]

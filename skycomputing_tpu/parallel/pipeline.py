@@ -64,21 +64,31 @@ def _split_microbatches(tree, num_microbatches: int):
     ]
 
 
-class StageRuntime:
-    """One pipeline stage: layer slice + device + compiled programs."""
+# Stage programs keyed by (canonical layer-config json, optimizer identity).
+# Deep pipelines repeat layer patterns, so many stages share a slice
+# structure — e.g. a 160-unit BERT split 8 ways has only a handful of
+# distinct slice shapes — and jit caches on function identity, which
+# per-stage closures would defeat.  Sharing the compiled programs cuts
+# compile counts severalfold for the MPMD engine and the benchmark.
+#
+# The cache is process-global and pins jitted executables (plus the
+# optimizer object, so its id cannot be recycled); long-lived processes
+# building many models should call clear_program_cache() between
+# generations.  Sharing across models requires passing the SAME optimizer
+# object — two equal-hyperparameter optax objects have different ids and
+# do not share (optax transforms expose no reliable value-hash to key on).
+_PROGRAM_CACHE: Dict = {}
 
-    def __init__(
-        self,
-        stage_index: int,
-        layer_cfgs: Sequence[Dict],
-        params: Sequence[Any],
-        device,
-        optimizer: optax.GradientTransformation,
-        slowdown: float = 1.0,
-        differentiable_inputs: bool = True,
-    ):
-        self.stage_index = stage_index
-        self.device = device
+
+def clear_program_cache() -> None:
+    """Release all cached stage programs (compiled executables)."""
+    _PROGRAM_CACHE.clear()
+
+
+class _StagePrograms:
+    """The jitted fwd/bwd/update programs for one layer-slice structure."""
+
+    def __init__(self, layer_cfgs, optimizer):
         self.stack = build_layer_stack(layer_cfgs)
         # eval twin: same params, dropout forced off (for configs that
         # carry a `deterministic` knob); used when forward gets no rng
@@ -89,16 +99,8 @@ class StageRuntime:
                 for cfg in layer_cfgs
             ]
         )
-        self.num_layers = len(layer_cfgs)
-        self.slowdown = float(slowdown)
-        self._differentiable_inputs = differentiable_inputs
-
-        self.params: List[Any] = jax.device_put(list(params), device)
-        self._optimizer = optimizer
-        self.opt_state = jax.device_put(optimizer.init(self.params), device)
-
-        stack = self.stack
-        eval_stack = self.eval_stack
+        self.optimizer = optimizer  # pinned: cache key uses id(optimizer)
+        stack, eval_stack = self.stack, self.eval_stack
 
         def fwd(params, inputs, rng):
             if rng is None:
@@ -127,16 +129,58 @@ class StageRuntime:
             return jax.tree_util.tree_map(jnp.add, a, b)
 
         def update(params, opt_state, grads):
-            updates, new_opt_state = self._optimizer.update(
-                grads, opt_state, params
-            )
+            updates, new_opt_state = optimizer.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), new_opt_state
 
-        self._fwd = jax.jit(fwd)
-        self._bwd = jax.jit(bwd)
-        self._bwd_params_only = jax.jit(bwd_params_only)
-        self._grad_add = jax.jit(grad_add)
-        self._update = jax.jit(update)
+        self.fwd = jax.jit(fwd)
+        self.bwd = jax.jit(bwd)
+        self.bwd_params_only = jax.jit(bwd_params_only)
+        self.grad_add = jax.jit(grad_add)
+        self.update = jax.jit(update)
+
+
+def get_stage_programs(layer_cfgs, optimizer) -> _StagePrograms:
+    import json
+
+    key = (
+        json.dumps(list(layer_cfgs), sort_keys=True, default=str),
+        id(optimizer),
+    )
+    if key not in _PROGRAM_CACHE:
+        _PROGRAM_CACHE[key] = _StagePrograms(layer_cfgs, optimizer)
+    return _PROGRAM_CACHE[key]
+
+
+class StageRuntime:
+    """One pipeline stage: layer slice + device + compiled programs."""
+
+    def __init__(
+        self,
+        stage_index: int,
+        layer_cfgs: Sequence[Dict],
+        params: Sequence[Any],
+        device,
+        optimizer: optax.GradientTransformation,
+        slowdown: float = 1.0,
+        differentiable_inputs: bool = True,
+    ):
+        self.stage_index = stage_index
+        self.device = device
+        self.num_layers = len(layer_cfgs)
+        self.slowdown = float(slowdown)
+        self._differentiable_inputs = differentiable_inputs
+
+        programs = get_stage_programs(layer_cfgs, optimizer)
+        self.stack = programs.stack
+        self._fwd = programs.fwd
+        self._bwd = programs.bwd
+        self._bwd_params_only = programs.bwd_params_only
+        self._grad_add = programs.grad_add
+        self._update = programs.update
+        self._optimizer = optimizer
+
+        self.params: List[Any] = jax.device_put(list(params), device)
+        self.opt_state = jax.device_put(optimizer.init(self.params), device)
 
     # --- execution ----------------------------------------------------------
     def forward(self, inputs: Tuple, rng) -> Tuple:
@@ -288,6 +332,7 @@ class PipelineModel:
         """Re-slice stages after a re-allocation (gathers weights first)."""
         self.sync_to_parameter_server()
         self._build_stages()
+        self._last_device = self.stages[-1].device
 
     # --- reference-API surface ---------------------------------------------
     @property

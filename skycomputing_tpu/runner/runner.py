@@ -163,5 +163,46 @@ class Runner:
 
         self._call_hook("after_run")
 
+    # --- evaluation ----------------------------------------------------------
+    def evaluate(self, data_loader, max_batches: Optional[int] = None) -> Dict:
+        """Eval pass: mean loss + accuracy over a dataloader.
+
+        Runs the pipeline forward in eval mode (no dropout rngs) with the
+        ``val`` hook lifecycle.  The reference has no eval loop at all —
+        its runner only trains — so this is capability the decomposed model
+        zoo makes free.
+        """
+        import numpy as np
+
+        self.model.train(False)
+        self._call_hook("before_val_epoch")
+        loss_sum = 0.0
+        correct = 0
+        total = 0
+        for i, (data, labels) in enumerate(data_loader):
+            if max_batches is not None and i >= max_batches:
+                break
+            self._call_hook("before_val_iter")
+            logits = self.model.forward(data)  # stays on device for the loss
+            labels = np.asarray(labels)
+            batch_loss = float(
+                self.model._loss_fn(logits, jax.numpy.asarray(labels))
+            )
+            n = len(labels)
+            # per-example weighting: a ragged final batch must not count
+            # its examples more than full batches do
+            loss_sum += batch_loss * n
+            logits_host = np.asarray(logits)
+            correct += int((logits_host.argmax(axis=-1) == labels).sum())
+            total += n
+            self._call_hook("after_val_iter")
+        self._call_hook("after_val_epoch")
+        self.model.train(True)
+        return {
+            "loss": loss_sum / total if total else float("nan"),
+            "accuracy": correct / total if total else float("nan"),
+            "num_examples": total,
+        }
+
 
 __all__ = ["Runner"]

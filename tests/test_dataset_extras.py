@@ -1,0 +1,93 @@
+"""file_utils resolution, tokenizer behavior, evaluate() loop."""
+
+import numpy as np
+import optax
+import pytest
+
+from skycomputing_tpu.dataset.glue.file_utils import cached_path
+from skycomputing_tpu.dataset.glue.tokenization import (
+    BertTokenizer,
+    build_synthetic_vocab,
+)
+
+
+def test_cached_path_local_and_data_home(tmp_path, monkeypatch):
+    f = tmp_path / "vocab.txt"
+    f.write_text("[PAD]\n[UNK]\n")
+    assert cached_path(str(f)) == str(f)
+
+    monkeypatch.setenv("SKYTPU_DATA_HOME", str(tmp_path))
+    assert cached_path("vocab.txt") == str(tmp_path / "vocab.txt")
+
+    with pytest.raises(FileNotFoundError, match="missing.txt"):
+        cached_path("missing.txt")
+
+
+def test_cached_path_rejects_urls():
+    with pytest.raises(OSError, match="no network egress"):
+        cached_path("https://example.com/vocab.txt")
+    with pytest.raises(OSError, match="no network egress"):
+        cached_path("s3://bucket/vocab.txt")
+
+
+def test_tokenizer_wordpiece_greedy():
+    vocab = {t: i for i, t in enumerate(
+        ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "un", "##aff", "##able",
+         "hello", "world", "!"]
+    )}
+    tok = BertTokenizer(vocab=vocab, do_lower_case=True)
+    assert tok.tokenize("unaffable") == ["un", "##aff", "##able"]
+    assert tok.tokenize("Hello, world!") == ["hello", "[UNK]", "world", "!"]
+    ids = tok.convert_tokens_to_ids(["hello", "nope"])
+    assert ids == [7, 1]  # unknown -> [UNK]
+
+
+def test_synthetic_vocab_deterministic():
+    assert build_synthetic_vocab(256) == build_synthetic_vocab(256)
+
+
+def test_runner_evaluate(devices):
+    import jax
+
+    from skycomputing_tpu.dataset import DataLoader, RandomBertDataset
+    from skycomputing_tpu.dynamics import (
+        Allocator,
+        ParameterServer,
+        WorkerManager,
+    )
+    from skycomputing_tpu.models import bert_config, bert_layer_configs
+    from skycomputing_tpu.ops import cross_entropy_loss
+    from skycomputing_tpu.parallel import PipelineModel
+    from skycomputing_tpu.runner import Runner
+
+    cfg = bert_config("tiny", dtype="float32", hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)
+    model_cfg = bert_layer_configs(cfg, num_encoder_units=1, num_classes=3,
+                                   deterministic=True)
+    wm = WorkerManager()
+    wm.load_worker_pool_from_config(
+        [dict(name=f"n{i}", device_config=dict(device_index=i),
+              extra_config={}) for i in range(2)]
+    )
+    Allocator(model_cfg, wm, None, None).even_allocate()
+
+    ds = RandomBertDataset(num_samples=32, max_seq_length=16, vocab_size=1024)
+    loader = DataLoader(ds, batch_size=8)
+
+    class Adapter:
+        def __len__(self):
+            return len(loader)
+
+        def __iter__(self):
+            for (ids, mask, segs), labels in loader:
+                yield (ids, segs, mask), labels
+
+    (ids, mask, segs), _ = next(iter(loader))
+    ps = ParameterServer(model_cfg, example_inputs=(ids, segs, mask))
+    model = PipelineModel(wm, ps, optax.sgd(1e-2), cross_entropy_loss,
+                          devices=devices)
+    runner = Runner(model, ps, wm, max_epochs=1, max_iters=2)
+    metrics = runner.evaluate(Adapter())
+    assert 0.0 <= metrics["accuracy"] <= 1.0
+    assert np.isfinite(metrics["loss"])
+    assert metrics["num_examples"] == 32

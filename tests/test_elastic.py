@@ -1,0 +1,79 @@
+"""Elastic membership: the reference left add/remove-worker unwired
+(``worker_manager.py:46-60`` scaffolding only); here a membership change
+re-allocates, rebuilds the pipeline, and training continues with the SAME
+weights (gathered to the parameter server across the transition)."""
+
+import jax
+import numpy as np
+import optax
+
+from skycomputing_tpu.dynamics import Allocator, ParameterServer, WorkerManager
+from skycomputing_tpu.models import bert_config, bert_layer_configs
+from skycomputing_tpu.ops import cross_entropy_loss
+from skycomputing_tpu.parallel import PipelineModel
+from skycomputing_tpu.utils.profiling import compiled_cost
+
+
+def test_worker_leaves_mid_training(devices):
+    cfg = bert_config("tiny", dtype="float32", hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)
+    model_cfg = bert_layer_configs(cfg, num_encoder_units=2, num_classes=3,
+                                   deterministic=True)
+    wm = WorkerManager()
+    wm.load_worker_pool_from_config(
+        [dict(name=f"n{i}", device_config=dict(device_index=i),
+              extra_config={}) for i in range(4)]
+    )
+    allocator = Allocator(model_cfg, wm, None, None)
+    allocator.even_allocate()
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(5, 1024, size=(8, 16)).astype(np.int32)
+    data = (ids, np.zeros_like(ids), np.ones_like(ids))
+    labels = rng.integers(0, 3, size=(8,)).astype(np.int32)
+
+    ps = ParameterServer(model_cfg, example_inputs=data)
+    model = PipelineModel(wm, ps, optax.sgd(1e-2), cross_entropy_loss,
+                          devices=devices)
+    model.train_step(data, labels, rng=jax.random.key(0))
+    logits_before = np.asarray(model.forward(data))
+
+    # a worker leaves the pool: re-rank, re-allocate, rebuild the pipeline
+    leaver = wm.worker_pool[1]
+    assert not leaver.is_running
+    model.sync_to_parameter_server()
+    wm.remove_worker_by_id(leaver.id)
+    assert wm.size == 3
+    allocator.even_allocate()
+    model.rebuild()
+
+    assert len(model.stages) == 3
+    # same weights survived the membership change
+    logits_after = np.asarray(model.forward(data))
+    np.testing.assert_allclose(logits_before, logits_after, rtol=2e-4,
+                               atol=2e-5)
+    # and training continues
+    loss = model.train_step(data, labels, rng=jax.random.key(1))
+    assert np.isfinite(loss)
+
+
+def test_worker_joins_pool(devices):
+    wm = WorkerManager()
+    wm.load_worker_pool_from_config(
+        [dict(name=f"n{i}", device_config=dict(device_index=i),
+              extra_config={}) for i in range(2)]
+    )
+    wm.add_worker("late-joiner", dict(name="n-late",
+                                      device_config=dict(device_index=2),
+                                      extra_config={}))
+    assert wm.size == 3
+    assert wm.get_by_id("late-joiner").rank == 2
+
+
+def test_profiling_compiled_cost():
+    import jax.numpy as jnp
+
+    cost = compiled_cost(lambda x: jnp.dot(x, x), np.ones((64, 64),
+                                                          np.float32))
+    assert cost["flops"] > 0
+    assert "argument_bytes" in cost
