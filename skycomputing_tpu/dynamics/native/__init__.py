@@ -1,0 +1,122 @@
+"""Native solver core: build-on-first-use + ctypes binding.
+
+pybind11 is not in the image, so the C++ core exposes a C ABI and is loaded
+with ctypes.  The shared object is compiled from ``solver.cpp`` with g++ on
+first use (cached next to the source); any failure — no compiler, readonly
+filesystem — degrades silently to the pure-Python solver.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "solver.cpp")
+_LIB = os.path.join(_HERE, "libskytpu_solver.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(
+        _SRC
+    ):
+        return True
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The solver library, or None when native support is unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+        lib.skytpu_solve_minmax.restype = ctypes.c_int
+        lib.skytpu_solve_minmax.argtypes = [
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_double,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_double),
+        ]
+        _lib = lib
+        return _lib
+
+
+def solve_minmax_native(
+    layer_cost,
+    layer_mem,
+    device_time,
+    device_mem,
+    tolerance: float = 1e-3,
+    max_iters: int = 60,
+) -> Optional[Tuple[List[int], List[Tuple[int, int]], float]]:
+    """Native exact solve; None if the library is unavailable or infeasible
+    is signalled as a RuntimeError (matching the Python solver)."""
+    lib = load()
+    if lib is None:
+        return None
+
+    L, D = len(layer_cost), len(device_time)
+    arr = lambda xs: (ctypes.c_double * len(xs))(*[float(x) for x in xs])
+    out_order = (ctypes.c_int * D)()
+    out_starts = (ctypes.c_int * D)()
+    out_ends = (ctypes.c_int * D)()
+    out_bottleneck = ctypes.c_double()
+
+    used = lib.skytpu_solve_minmax(
+        L,
+        D,
+        arr(layer_cost),
+        arr(layer_mem),
+        arr(device_time),
+        arr(device_mem),
+        tolerance,
+        max_iters,
+        out_order,
+        out_starts,
+        out_ends,
+        ctypes.byref(out_bottleneck),
+    )
+    if used == -2:
+        return None  # out-of-range problem size: let Python handle it
+    if used < 0:
+        raise RuntimeError(
+            "allocation infeasible: memory capacities cannot hold the model "
+            f"(layers={L}, devices={D})"
+        )
+    order = [out_order[i] for i in range(used)]
+    slices = [(out_starts[i], out_ends[i]) for i in range(used)]
+    return order, slices, float(out_bottleneck.value)
+
+
+__all__ = ["solve_minmax_native", "load"]

@@ -1,0 +1,184 @@
+// Exact contiguous layer->device partition solver (native core).
+//
+// The reference obtains native solving power by shelling out to the CBC MIP
+// solver through pulp (reference: scaelum/dynamics/allocator.py:109-132).
+// This is the TPU build's native equivalent: the same optimization problem
+// — partition a layer sequence into contiguous slices on distinct devices,
+// free device order, per-device memory capacity, minimize the bottleneck
+// max_d device_time[d] * sum(layer_cost[slice_d]) — solved exactly by
+// binary search over the bottleneck T with a subset-DP feasibility check
+// (frontier[mask] = furthest layer reachable using device set `mask`;
+// dominance is valid because reachability is monotone in the start index).
+//
+// Complexity per feasibility probe: O(2^D * D * log L).  In native code the
+// exact regime extends to ~22 devices (the pure-Python DP in solver.py caps
+// at 12); beyond that the Python greedy takes over.
+//
+// C ABI, consumed via ctypes (no pybind11 in the image).
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <limits>
+#include <vector>
+
+namespace {
+
+// furthest layer index reachable from `start` on device `d` under budget T
+int cover(int start, int d, double T, int L,
+          const std::vector<double>& cost_prefix,
+          const std::vector<double>& mem_prefix,
+          const double* device_time, const double* device_mem) {
+  if (start >= L) return L;
+  const double dt = device_time[d];
+  const double cost_budget =
+      dt > 0 ? T / dt : std::numeric_limits<double>::infinity();
+
+  // binary search: largest r with cost_prefix[r] <= cost_prefix[start]+budget
+  auto search = [&](const std::vector<double>& prefix, double budget) {
+    const double limit = prefix[start] + budget + 1e-12;
+    int lo = start, hi = L;  // invariant: prefix[lo] <= limit
+    while (lo < hi) {
+      int mid = (lo + hi + 1) / 2;
+      if (prefix[mid] <= limit) lo = mid;
+      else hi = mid - 1;
+    }
+    return lo;
+  };
+
+  const int r_cost = search(cost_prefix, cost_budget);
+  const int r_mem = search(mem_prefix, device_mem[d] + 1e-9);
+  const int r = r_cost < r_mem ? r_cost : r_mem;
+  return r > start ? r : start;
+}
+
+// subset DP; fills order/slices on success, returns used-device count or -1
+int feasible(double T, int L, int D,
+             const std::vector<double>& cost_prefix,
+             const std::vector<double>& mem_prefix,
+             const double* device_time, const double* device_mem,
+             std::vector<int>& frontier, std::vector<int>& choice,
+             int* out_order, int* out_starts, int* out_ends) {
+  const std::size_t size = std::size_t(1) << D;
+  frontier.assign(size, 0);
+  choice.assign(size, -1);
+
+  std::size_t full = 0;
+  for (std::size_t mask = 1; mask < size; ++mask) {
+    int best = 0, best_d = -1;
+    std::size_t m = mask;
+    while (m) {
+      const std::size_t low = m & (~m + 1);
+      const int d = __builtin_ctzll(low);
+      m ^= low;
+      const int prev = frontier[mask ^ low];
+      const int reach =
+          cover(prev, d, T, L, cost_prefix, mem_prefix, device_time,
+                device_mem);
+      if (best_d == -1 || reach > best) {
+        best = reach;
+        best_d = d;
+      }
+    }
+    frontier[mask] = best;
+    choice[mask] = best_d;
+    if (best >= L) {
+      full = mask;
+      break;
+    }
+  }
+  if (full == 0) return -1;
+
+  // peel choices: device order along the pipeline is the reverse of peeling
+  std::vector<int> order_rev;
+  std::size_t mask = full;
+  while (mask) {
+    const int d = choice[mask];
+    order_rev.push_back(d);
+    mask ^= std::size_t(1) << d;
+  }
+
+  int used = 0, pos = 0;
+  for (auto it = order_rev.rbegin(); it != order_rev.rend(); ++it) {
+    const int d = *it;
+    const int end = cover(pos, d, T, L, cost_prefix, mem_prefix, device_time,
+                          device_mem);
+    if (end > pos) {
+      out_order[used] = d;
+      out_starts[used] = pos;
+      out_ends[used] = end;
+      ++used;
+    }
+    pos = end;
+  }
+  return pos >= L ? used : -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns the number of used devices (>0) on success, -1 if infeasible.
+// out_order/out_starts/out_ends must have room for D entries.
+int skytpu_solve_minmax(int L, int D, const double* layer_cost,
+                        const double* layer_mem, const double* device_time,
+                        const double* device_mem, double tolerance,
+                        int max_iters, int* out_order, int* out_starts,
+                        int* out_ends, double* out_bottleneck) {
+  if (L <= 0 || D <= 0 || D > 30) return -2;
+
+  std::vector<double> cost_prefix(L + 1, 0.0), mem_prefix(L + 1, 0.0);
+  double total_cost = 0.0, max_dt = 0.0;
+  for (int i = 0; i < L; ++i) {
+    cost_prefix[i + 1] = cost_prefix[i] + layer_cost[i];
+    mem_prefix[i + 1] = mem_prefix[i] + layer_mem[i];
+    total_cost += layer_cost[i];
+  }
+  for (int d = 0; d < D; ++d) max_dt = std::max(max_dt, device_time[d]);
+
+  std::vector<int> frontier, choice;
+  std::vector<int> best_order(D), best_starts(D), best_ends(D);
+
+  double hi = total_cost * max_dt;
+  double lo = 0.0;
+
+  int best_used =
+      feasible(hi, L, D, cost_prefix, mem_prefix, device_time, device_mem,
+               frontier, choice, best_order.data(), best_starts.data(),
+               best_ends.data());
+  if (best_used < 0) return -1;
+
+  for (int it = 0; it < max_iters; ++it) {
+    if (hi - lo <= tolerance * (hi > 1e-30 ? hi : 1e-30)) break;
+    const double mid = 0.5 * (lo + hi);
+    std::vector<int> order(D), starts(D), ends(D);
+    const int used =
+        feasible(mid, L, D, cost_prefix, mem_prefix, device_time, device_mem,
+                 frontier, choice, order.data(), starts.data(), ends.data());
+    if (used > 0) {
+      best_used = used;
+      best_order = order;
+      best_starts = starts;
+      best_ends = ends;
+      hi = mid;
+    } else {
+      lo = mid;
+    }
+  }
+
+  double achieved = 0.0;
+  for (int i = 0; i < best_used; ++i) {
+    const int d = best_order[i];
+    const double t =
+        device_time[d] *
+        (cost_prefix[best_ends[i]] - cost_prefix[best_starts[i]]);
+    achieved = std::max(achieved, t);
+    out_order[i] = d;
+    out_starts[i] = best_starts[i];
+    out_ends[i] = best_ends[i];
+  }
+  *out_bottleneck = achieved;
+  return best_used;
+}
+
+}  // extern "C"

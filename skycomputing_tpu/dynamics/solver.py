@@ -179,12 +179,23 @@ def solve_contiguous_minmax(
     tolerance: float = 1e-3,
     greedy_attempts: int = 20,
     seed: int = 0,
+    use_native: bool = True,
+    native_exact_limit: int = 18,
 ) -> PartitionResult:
     """Minimize max_d device_time[d] * sum(layer_cost[slice_d]).
 
     Subject to: slices contiguous and disjoint, covering all layers; device
     order free; sum(layer_mem[slice_d]) <= device_mem[d]; empty devices
     allowed (reference MIP allows them too — constraint 4 with sum(x)=0).
+
+    The exact subset-DP runs in the native C++ core when available
+    (``dynamics/native`` — the CBC analog), extending the exact regime from
+    ``exact_limit`` (pure Python) to ``native_exact_limit`` devices; the
+    randomized greedy covers larger clusters either way.  The DP is
+    exponential in D (~0.06s at D=14, ~1s at D=18, roughly x4.5 per +2
+    devices on current hardware); raise ``native_exact_limit`` toward 22
+    only if tens of seconds per allocation is acceptable — the reference
+    gave its MIP solver a 300s budget, so that can be a fair trade.
     """
     D = len(device_time)
     L = len(layer_cost)
@@ -192,6 +203,17 @@ def solve_contiguous_minmax(
         return PartitionResult([], [], 0.0)
     if D == 0:
         raise ValueError("no devices")
+
+    if use_native and D <= native_exact_limit:
+        from . import native
+
+        solved = native.solve_minmax_native(
+            layer_cost, layer_mem, device_time, device_mem,
+            tolerance=tolerance,
+        )
+        if solved is not None:
+            order, slices, bottleneck = solved
+            return PartitionResult(order, slices, bottleneck)
 
     table = _CoverTable(layer_cost, layer_mem, device_time, device_mem)
     rng = random.Random(seed)

@@ -86,6 +86,12 @@ class CompiledBertPipeline:
         self.cfg = BertConfig.from_dict(config)
         self.mesh = mesh
         self.num_stages = int(mesh.shape["pp"])
+        # optional data-parallel axis: batch sharded over 'dp', stage params
+        # replicated across it.  Inside the shard_map the stage-grad
+        # reduction over 'dp' comes from the spec-driven transpose (params'
+        # in_spec P('pp') omits 'dp', so the cotangent is psummed over it);
+        # GSPMD handles only the code outside the shard_map.
+        self.dp = int(mesh.shape["dp"]) if "dp" in mesh.shape else 1
         self.units_per_stage = units_per_stage
         self.num_classes = num_classes
         self.num_microbatches = num_microbatches or self.num_stages
@@ -193,16 +199,19 @@ class CompiledBertPipeline:
             )
             return outputs
 
+        # activations: microbatch axis 0 gathers per-stage buffers ('pp'),
+        # per-microbatch batch axis 1 stays sharded over 'dp' (if present)
+        act_spec = P(None, "dp") if self.dp > 1 else P()
+        out_spec = P("pp", "dp") if self.dp > 1 else P("pp")
         out = jax.shard_map(
             body,
             mesh=self.mesh,
-            in_specs=(self._stage_spec, P(), P()),
-            out_specs=P("pp"),
+            in_specs=(self._stage_spec, act_spec, act_spec),
+            out_specs=out_spec,
             check_vma=False,
         )(stage_params, hidden_mb, mask_mb)
-        # out_specs=P('pp') concatenates per-stage [M, ...] buffers along
-        # axis 0 -> [S*M, ...]; only the last stage's block holds the
-        # completed microbatches
+        # axis 0 concatenates per-stage [M, ...] buffers -> [S*M, ...]; only
+        # the last stage's block holds the completed microbatches
         return out[-M:]
 
     # --- full model ----------------------------------------------------------
@@ -215,6 +224,10 @@ class CompiledBertPipeline:
         B = hidden.shape[0]
         if B % M != 0:
             raise ValueError(f"batch {B} not divisible by microbatches {M}")
+        if (B // M) % self.dp != 0:
+            raise ValueError(
+                f"microbatch size {B // M} not divisible by dp={self.dp}"
+            )
         hidden_mb = hidden.reshape(M, B // M, *hidden.shape[1:])
         mask_mb = mask4.reshape(M, B // M, *mask4.shape[1:])
 

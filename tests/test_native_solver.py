@@ -1,0 +1,78 @@
+"""Native C++ solver core: builds, matches the Python DP and brute force."""
+
+import random
+
+import pytest
+
+from skycomputing_tpu.dynamics.native import load, solve_minmax_native
+from skycomputing_tpu.dynamics.solver import solve_contiguous_minmax
+from tests.test_solver import brute_force_minmax
+
+
+needs_native = pytest.mark.skipif(
+    load() is None, reason="native solver unavailable (no g++?)"
+)
+
+
+@needs_native
+@pytest.mark.parametrize("seed", range(4))
+def test_native_matches_brute_force(seed):
+    rng = random.Random(seed)
+    L = rng.randint(4, 8)
+    D = rng.randint(2, 4)
+    layer_cost = [rng.uniform(0.5, 3.0) for _ in range(L)]
+    layer_mem = [rng.uniform(0.5, 2.0) for _ in range(L)]
+    device_time = [rng.uniform(1.0, 4.0) for _ in range(D)]
+    device_mem = [sum(layer_mem)] * D
+
+    order, slices, bottleneck = solve_minmax_native(
+        layer_cost, layer_mem, device_time, device_mem, tolerance=1e-6
+    )
+    expected = brute_force_minmax(layer_cost, layer_mem, device_time,
+                                  device_mem)
+    assert bottleneck == pytest.approx(expected, rel=1e-3)
+    # valid partition
+    pos = 0
+    for s, e in sorted(slices):
+        assert s == pos
+        pos = e
+    assert pos == L
+
+
+@needs_native
+def test_native_matches_python_dp_large():
+    rng = random.Random(3)
+    L, D = 100, 14  # above the pure-Python exact_limit of 12
+    layer_cost = [rng.uniform(0.5, 1.5) for _ in range(L)]
+    layer_mem = [rng.uniform(0.1, 0.5) for _ in range(L)]
+    device_time = [rng.uniform(1.0, 4.0) for _ in range(D)]
+    device_mem = [rng.uniform(5.0, 20.0) for _ in range(D)]
+
+    native = solve_minmax_native(layer_cost, layer_mem, device_time,
+                                 device_mem, tolerance=1e-6)
+    python = solve_contiguous_minmax(
+        layer_cost, layer_mem, device_time, device_mem,
+        exact_limit=14, tolerance=1e-6, use_native=False,
+    )
+    assert native[2] == pytest.approx(python.bottleneck, rel=1e-3)
+
+
+@needs_native
+def test_native_infeasible_raises():
+    with pytest.raises(RuntimeError, match="infeasible"):
+        solve_minmax_native([1.0, 1.0], [10.0, 10.0], [1.0, 1.0], [1.0, 1.0])
+
+
+def test_solver_front_door_uses_native_transparently():
+    # through the public API the result must be identical either way
+    rng = random.Random(9)
+    L, D = 30, 6
+    layer_cost = [rng.uniform(0.5, 1.5) for _ in range(L)]
+    layer_mem = [0.1] * L
+    device_time = [rng.uniform(1.0, 4.0) for _ in range(D)]
+    device_mem = [100.0] * D
+    a = solve_contiguous_minmax(layer_cost, layer_mem, device_time,
+                                device_mem, tolerance=1e-6, use_native=True)
+    b = solve_contiguous_minmax(layer_cost, layer_mem, device_time,
+                                device_mem, tolerance=1e-6, use_native=False)
+    assert a.bottleneck == pytest.approx(b.bottleneck, rel=1e-3)
