@@ -1,0 +1,11 @@
+"""Ladder config 3: dynamic allocation, 8 workers, stimulated heterogeneity."""
+
+import os
+
+os.environ["SKYTPU_ALLOCATE_TYPE"] = "dynamic"
+os.environ["SKYTPU_CORE_NUM"] = "8"
+os.environ["SKYTPU_LAYER_NUM"] = "10"
+os.environ.setdefault("SKYTPU_PRESET", "large")
+os.environ.setdefault("STIMULATE", "1")
+
+base = "../config.py"

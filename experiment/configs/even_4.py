@@ -1,0 +1,10 @@
+"""Ladder config 1: BERT-large MNLI, even allocation, 4 workers."""
+
+import os
+
+os.environ["SKYTPU_ALLOCATE_TYPE"] = "even"
+os.environ["SKYTPU_CORE_NUM"] = "4"
+os.environ["SKYTPU_LAYER_NUM"] = "10"
+os.environ.setdefault("SKYTPU_PRESET", "large")
+
+base = "../config.py"

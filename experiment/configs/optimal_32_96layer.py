@@ -1,0 +1,10 @@
+"""Ladder config 4: 96-layer stacked BERT, optimal allocation, 32 workers."""
+
+import os
+
+os.environ["SKYTPU_ALLOCATE_TYPE"] = "optimal"
+os.environ["SKYTPU_CORE_NUM"] = "32"
+os.environ["SKYTPU_LAYER_NUM"] = "31"  # 93 encoder units + ends ~ 96 layers
+os.environ.setdefault("SKYTPU_PRESET", "large")
+
+base = "../config.py"

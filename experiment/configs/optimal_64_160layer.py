@@ -1,0 +1,12 @@
+"""Ladder config 5: 160-layer stacked BERT, optimal allocation, 64 workers
+(the paper-repro scale; compare against even with --allocate-type even)."""
+
+import os
+
+os.environ["SKYTPU_ALLOCATE_TYPE"] = "optimal"
+os.environ["SKYTPU_CORE_NUM"] = "64"
+os.environ["SKYTPU_LAYER_NUM"] = "53"  # 159 encoder units + ends ~ 160 layers
+os.environ.setdefault("SKYTPU_PRESET", "large")
+os.environ.setdefault("STIMULATE", "1")
+
+base = "../config.py"

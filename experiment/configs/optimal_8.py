@@ -1,0 +1,10 @@
+"""Ladder config 2: BERT-large MNLI, optimal allocation, 8 workers."""
+
+import os
+
+os.environ["SKYTPU_ALLOCATE_TYPE"] = "optimal"
+os.environ["SKYTPU_CORE_NUM"] = "8"
+os.environ["SKYTPU_LAYER_NUM"] = "10"
+os.environ.setdefault("SKYTPU_PRESET", "large")
+
+base = "../config.py"

@@ -1,0 +1,153 @@
+"""Long-context BERT units: sequence-parallel attention over an 'sp' mesh.
+
+The reference caps sequences at 128 tokens with O(L^2) full-softmax attention
+(``experiment/config.py:113``, ``bert_layers.py:249-275``) — long context is
+new capability, not parity.  ``LongBertLayer_Head`` is a drop-in replacement
+for ``BertLayer_Head`` whose attention runs as **ring attention**
+(:mod:`skycomputing_tpu.parallel.ring_attention`): hidden states arrive
+sequence-sharded across the mesh's ``sp`` axis, each device keeps its query
+block resident, and key/value/bias blocks rotate around the ICI ring with an
+online softmax, so per-chip attention memory is O(L/S) and sequence length
+scales with the ring size.
+
+Parameter structure matches ``BertLayer_Head`` exactly (``self.query/key/
+value`` + ``output.dense``/``output.LayerNorm``), so weights interchange with
+the short-context zoo and checkpoints are compatible.  One behavioral
+difference: attention-probability dropout cannot exist under an online
+softmax (the probability matrix is never materialized), so training with
+``attention_probs_dropout_prob > 0`` raises instead of silently diverging
+from the standard head's regularization.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+from ..registry import LAYER
+from .bert import BertSelfOutput, _cfg, _dense, _dtype
+
+
+class LongBertSelfAttention(nn.Module):
+    """Multi-head self-attention computed as a ring over the 'sp' axis."""
+
+    config: Any
+    deterministic: bool = False
+    mesh: Any = None
+    axis_name: str = "sp"
+
+    @nn.compact
+    def __call__(self, hidden_states, attention_mask):
+        cfg = _cfg(self.config)
+        if cfg.attention_probs_dropout_prob > 0 and not self.deterministic:
+            # online-softmax attention cannot apply per-probability dropout
+            # (the probability matrix is never materialized); fail loudly
+            # rather than silently training with different regularization
+            # than the short-context head
+            raise ValueError(
+                "LongBertSelfAttention does not support attention-probs "
+                "dropout; set attention_probs_dropout_prob=0 or "
+                "deterministic=True"
+            )
+        n_heads = cfg.num_attention_heads
+        head_dim = cfg.hidden_size // n_heads
+
+        def split_heads(x):
+            return x.reshape(x.shape[0], x.shape[1], n_heads, head_dim)
+
+        q = split_heads(_dense(cfg, cfg.hidden_size, "query")(hidden_states))
+        k = split_heads(_dense(cfg, cfg.hidden_size, "key")(hidden_states))
+        v = split_heads(_dense(cfg, cfg.hidden_size, "value")(hidden_states))
+
+        # BERT's extended mask [B,1,1,L] -> per-key additive bias [B, L]
+        bias = attention_mask[:, 0, 0, :]
+
+        if self.mesh is not None:
+            from ..parallel.ring_attention import ring_attention
+
+            context = ring_attention(
+                q, k, v, self.mesh, axis_name=self.axis_name, bias=bias
+            )
+        else:
+            from ..parallel.ring_attention import full_attention_reference
+
+            context = full_attention_reference(q, k, v, bias=bias)
+
+        return context.reshape(
+            context.shape[0], context.shape[1], cfg.hidden_size
+        ).astype(_dtype(cfg))
+
+
+@LAYER.register_module
+class LongBertLayer_Head(nn.Module):
+    """Sequence-parallel drop-in for ``BertLayer_Head``."""
+
+    config: Any
+    deterministic: bool = False
+    mesh: Any = None
+    axis_name: str = "sp"
+
+    @nn.compact
+    def __call__(self, hidden_states, attention_mask):
+        cfg = _cfg(self.config)
+        self_out = LongBertSelfAttention(
+            cfg.to_dict(), self.deterministic, self.mesh, self.axis_name,
+            name="self",
+        )(hidden_states, attention_mask)
+        attn_out = BertSelfOutput(cfg.to_dict(), self.deterministic,
+                                  name="output")(self_out, hidden_states)
+        return attn_out, attention_mask
+
+
+def long_bert_layer_configs(
+    config: Any,
+    num_encoder_units: int,
+    mesh: Any,
+    num_classes: int = 3,
+    deterministic: bool = False,
+    axis_name: str = "sp",
+) -> list:
+    """Layer-config list with ring-attention heads (bodies/tails unchanged —
+    they are position-wise and shard over the sequence for free)."""
+    cfg = _cfg(config)
+    encoder = []
+    for _ in range(num_encoder_units):
+        encoder.append(
+            dict(layer_type="LongBertLayer_Head", config=cfg.to_dict(),
+                 deterministic=deterministic, mesh=mesh,
+                 axis_name=axis_name)
+        )
+        encoder.append(
+            dict(layer_type="BertLayer_Body", config=cfg.to_dict(),
+                 deterministic=deterministic)
+        )
+        encoder.append(
+            dict(layer_type="BertLayer_Tail", config=cfg.to_dict(),
+                 deterministic=deterministic)
+        )
+    return (
+        [dict(layer_type="BertEmbeddings", config=cfg.to_dict(),
+              deterministic=deterministic)]
+        + encoder
+        + [
+            dict(layer_type="BertPooler", config=cfg.to_dict(),
+                 deterministic=deterministic),
+            dict(
+                layer_type="BertTailForClassification",
+                hidden_dropout_prob=cfg.hidden_dropout_prob,
+                hidden_size=cfg.hidden_size,
+                num_classes=num_classes,
+                deterministic=deterministic,
+                dtype=cfg.dtype,
+            ),
+        ]
+    )
+
+
+__all__ = [
+    "LongBertSelfAttention",
+    "LongBertLayer_Head",
+    "long_bert_layer_configs",
+]

@@ -1,4 +1,6 @@
 from .mesh import make_dp_pp_mesh, make_pipeline_mesh
+from .multihost import global_mesh, initialize_from_env, is_coordinator
+from .ring_attention import full_attention_reference, ring_attention
 from .pipeline import (
     PipelineModel,
     PipelineStats,
@@ -13,4 +15,9 @@ __all__ = [
     "PipelineStats",
     "StageRuntime",
     "clear_program_cache",
+    "global_mesh",
+    "initialize_from_env",
+    "is_coordinator",
+    "ring_attention",
+    "full_attention_reference",
 ]

@@ -46,6 +46,7 @@ def ring_attention(
     axis_name: str = "sp",
     causal: bool = False,
     scale: Optional[float] = None,
+    bias: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Exact attention with q/k/v sharded on the sequence axis.
 
@@ -53,6 +54,9 @@ def ring_attention(
         q, k, v: [batch, seq, heads, head_dim], sharded on ``seq`` over
             ``axis_name`` (global views; shard_map slices them).
         causal: apply a causal mask using *global* positions.
+        bias: optional additive per-key bias [batch, seq] (padding masks,
+            BERT's ``(1-mask)*-1e4``), sharded on ``seq`` like k; rotated
+            around the ring alongside the key/value blocks.
 
     Returns [batch, seq, heads, head_dim], sequence-sharded like q.
     """
@@ -60,9 +64,10 @@ def ring_attention(
         scale = q.shape[-1] ** -0.5
     S = int(mesh.shape[axis_name])
     ring = [(i, (i + 1) % S) for i in range(S)]
+    has_bias = bias is not None
 
-    def local_fn(q_blk, k_blk, v_blk):
-        # local shapes: [B, Lb, H, D]
+    def local_fn(q_blk, k_blk, v_blk, bias_blk):
+        # local shapes: [B, Lb, H, D]; bias [B, Lb]
         idx = lax.axis_index(axis_name)
         B, Lb, H, D = q_blk.shape
         q_f32 = q_blk.astype(jnp.float32) * scale
@@ -74,10 +79,12 @@ def ring_attention(
         q_pos = idx * Lb + jnp.arange(Lb)
 
         def step(carry, i):
-            o, m, l, k_cur, v_cur = carry
+            o, m, l, k_cur, v_cur, b_cur = carry
             scores = jnp.einsum(
                 "bqhd,bkhd->bhqk", q_f32, k_cur.astype(jnp.float32)
             )
+            if has_bias:
+                scores = scores + b_cur.astype(jnp.float32)[:, None, None, :]
             if causal:
                 # after i rotations this device holds the block that
                 # originated on device (idx - i) mod S
@@ -88,26 +95,36 @@ def ring_attention(
             o2, m2, l2 = _online_block_update(o, m, l, scores, v_cur)
             k_nxt = lax.ppermute(k_cur, axis_name, ring)
             v_nxt = lax.ppermute(v_cur, axis_name, ring)
-            return (o2, m2, l2, k_nxt, v_nxt), None
+            # rotate the bias with its key block only when one exists — a
+            # dummy bias would cost a real collective per ring step
+            b_nxt = (
+                lax.ppermute(b_cur, axis_name, ring) if has_bias else b_cur
+            )
+            return (o2, m2, l2, k_nxt, v_nxt, b_nxt), None
 
-        (o, m, l, _, _), _ = lax.scan(
-            step, (o, m, l, k_blk, v_blk), jnp.arange(S)
+        (o, m, l, _, _, _), _ = lax.scan(
+            step, (o, m, l, k_blk, v_blk, bias_blk), jnp.arange(S)
         )
         # fully-masked rows (causal, early global positions) have l == 0
         denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
         return (o / denom).astype(q_blk.dtype)
 
     seq_spec = P(None, axis_name, None, None)
+    bias_spec = P(None, axis_name)
+    if not has_bias:
+        # zero-size placeholder keeps one code path; it is never read or
+        # permuted (has_bias is trace-time static)
+        bias = jnp.zeros((q.shape[0], q.shape[1]), jnp.float32)
     return jax.shard_map(
         local_fn,
         mesh=mesh,
-        in_specs=(seq_spec, seq_spec, seq_spec),
+        in_specs=(seq_spec, seq_spec, seq_spec, bias_spec),
         out_specs=seq_spec,
         check_vma=False,
-    )(q, k, v)
+    )(q, k, v, bias)
 
 
-def full_attention_reference(q, k, v, causal=False, scale=None):
+def full_attention_reference(q, k, v, causal=False, scale=None, bias=None):
     """Single-device O(L^2) reference for testing."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -115,6 +132,8 @@ def full_attention_reference(q, k, v, causal=False, scale=None):
         "bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
         k.astype(jnp.float32),
     )
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)[:, None, None, :]
     if causal:
         L = q.shape[1]
         allowed = jnp.tril(jnp.ones((L, L), bool))

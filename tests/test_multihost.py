@@ -1,0 +1,26 @@
+"""Multi-host glue: single-process behavior + mesh construction."""
+
+import numpy as np
+import pytest
+
+from skycomputing_tpu.parallel import (
+    global_mesh,
+    initialize_from_env,
+    is_coordinator,
+)
+
+
+def test_initialize_noop_without_coordinator(monkeypatch):
+    monkeypatch.delenv("SKYTPU_COORDINATOR", raising=False)
+    assert initialize_from_env() is False  # single-process: no-op
+
+
+def test_global_mesh_shapes(devices):
+    mesh = global_mesh(("dp", "pp"), (2, 4))
+    assert dict(mesh.shape) == {"dp": 2, "pp": 4}
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        global_mesh(("dp", "pp"), (4, 4))
+
+
+def test_is_coordinator_single_process():
+    assert is_coordinator() is True
