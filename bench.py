@@ -28,11 +28,12 @@ divides by the reference's published 55%.
 Prints exactly one JSON line:
     {"metric": ..., "value": ..., "unit": "percent", "vs_baseline": ...}
 
-Env knobs: SKYTPU_BENCH_WORKERS (8), SKYTPU_BENCH_LAYER_NUM (16 trios),
-SKYTPU_BENCH_PRESET (large), SKYTPU_BENCH_BATCH (32),
-SKYTPU_BENCH_MICROBATCHES (2x workers), SKYTPU_BENCH_SLOWDOWN
-(paper | stimulator), SKYTPU_BENCH_SEQUENTIAL=1 to score the reference's
-non-microbatched schedule (sum of stage times) instead.
+Env knobs: SKYTPU_BENCH_WORKERS (64), SKYTPU_BENCH_LAYER_NUM (53 trios ->
+the paper's 160-layer scale), SKYTPU_BENCH_PRESET (large),
+SKYTPU_BENCH_BATCH (32), SKYTPU_BENCH_MICROBATCHES (2x workers),
+SKYTPU_BENCH_SLOWDOWN (paper | stimulator), SKYTPU_BENCH_REPEATS (2),
+SKYTPU_BENCH_SEQUENTIAL=1 to score the reference's non-microbatched
+schedule (sum of stage times) instead.
 """
 
 from __future__ import annotations
@@ -88,13 +89,17 @@ def main() -> int:
     from skycomputing_tpu.ops import cross_entropy_loss
     from skycomputing_tpu.parallel import PipelineModel
 
-    n_workers = int(os.getenv("SKYTPU_BENCH_WORKERS", "8"))
-    layer_num = int(os.getenv("SKYTPU_BENCH_LAYER_NUM", "16"))
+    # defaults reproduce the paper's headline scale: 160-layer stacked
+    # BERT-large (53 trios + ends = 162 units) over 64 heterogeneous
+    # workers, GPipe with 2 microbatches per worker
+    n_workers = int(os.getenv("SKYTPU_BENCH_WORKERS", "64"))
+    layer_num = int(os.getenv("SKYTPU_BENCH_LAYER_NUM", "53"))
     preset = os.getenv("SKYTPU_BENCH_PRESET", "large")
     batch = int(os.getenv("SKYTPU_BENCH_BATCH", "32"))
     n_micro = int(os.getenv("SKYTPU_BENCH_MICROBATCHES", str(2 * n_workers)))
     slowdown_kind = os.getenv("SKYTPU_BENCH_SLOWDOWN", "paper")
     sequential = os.getenv("SKYTPU_BENCH_SEQUENTIAL") == "1"
+    repeats = int(os.getenv("SKYTPU_BENCH_REPEATS", "2"))
     seq = 128
 
     devices = jax.devices()
@@ -185,7 +190,8 @@ def main() -> int:
         if not np.isfinite(loss):
             raise RuntimeError(f"{alloc_type}: non-finite loss {loss}")
 
-        measured = model.measure_stage_times(data)
+        measured = model.measure_stage_times(data, repeats=repeats,
+                                             inner_iters=2)
         taus = [t * s for t, s in zip(measured, stage_slowdowns)]
         step_times[alloc_type] = schedule_step_time(taus, n_micro, sequential)
         print(

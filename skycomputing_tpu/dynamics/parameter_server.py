@@ -13,6 +13,7 @@ analog, no torch involved.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -69,6 +70,39 @@ class ParameterServer:
                 for ref, new in zip(self.params, layers)
             ]
         self.params = list(layers)
+
+    # --- orbax checkpoint io (directory-based, async-capable) ---------------
+    def save_orbax(self, ckpt_dir: str) -> None:
+        """Save via orbax (the TPU ecosystem's checkpoint layer).
+
+        Same layer-indexed layout as the msgpack path, so both formats are
+        partition-independent; orbax adds async writes and per-array files
+        that scale to sharded multi-host checkpoints.
+        """
+        import orbax.checkpoint as ocp
+
+        host_params = jax.tree_util.tree_map(np.asarray, self.params)
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(
+            os.path.abspath(ckpt_dir), {"layers": host_params}, force=True
+        )
+        ckptr.wait_until_finished()
+
+    def load_orbax(self, ckpt_dir: str) -> None:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        target = None
+        if self.params:
+            # abstract template: structure + dtypes only, no data copy
+            target = {
+                "layers": jax.tree_util.tree_map(
+                    lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    self.params,
+                )
+            }
+        restored = ckptr.restore(os.path.abspath(ckpt_dir), target)
+        self.params = list(restored["layers"])
 
     # --- per-layer exchange with stages ------------------------------------
     def update_weights(self, state: Any, idx: int) -> None:

@@ -1,4 +1,19 @@
 from .flash_attention import flash_attention
 from .losses import build_loss, cross_entropy_loss, mse_loss
+from .metrics import (
+    accuracy,
+    compute_task_metrics,
+    f1_score,
+    matthews_corrcoef,
+)
 
-__all__ = ["build_loss", "cross_entropy_loss", "mse_loss", "flash_attention"]
+__all__ = [
+    "build_loss",
+    "cross_entropy_loss",
+    "mse_loss",
+    "flash_attention",
+    "accuracy",
+    "compute_task_metrics",
+    "f1_score",
+    "matthews_corrcoef",
+]

@@ -437,16 +437,21 @@ class PipelineModel:
 
     # --- profiling ----------------------------------------------------------
     def measure_stage_times(
-        self, data, rng: Optional[jax.Array] = None, repeats: int = 3
+        self,
+        data,
+        rng: Optional[jax.Array] = None,
+        repeats: int = 3,
+        inner_iters: int = 3,
     ) -> List[float]:
         """Real per-stage forward+backward seconds on their devices.
 
-        Warm-compiles first, then takes the median of ``repeats`` timed
-        executions per stage with proper blocking.  This is the honest
-        per-stage cost profile the pipelined step time is built from — on a
-        shared device, per-call elapsed times inside a full step are
-        polluted by dispatch latency and queueing, so stages are timed in
-        isolation here.
+        Warm-compiles first, then takes the median of ``repeats`` samples,
+        each timing ``inner_iters`` chained fwd+bwd executions with ONE
+        final block — chaining amortizes per-call dispatch latency (which
+        on a tunneled/remote device can exceed small-stage compute) out of
+        the per-iteration figure.  This is the honest per-stage cost
+        profile the pipelined step time is built from — per-call elapsed
+        times inside a full step are polluted by queueing.
         """
         if rng is None:
             rng = jax.random.key(0)
@@ -469,15 +474,19 @@ class PipelineModel:
             samples = []
             for _ in range(repeats):
                 t0 = time.perf_counter()
-                o = stage._fwd(stage.params, inputs, stage_rng)
-                if stage._differentiable_inputs:
-                    g = stage._bwd(stage.params, inputs, stage_rng, dy)
-                else:
-                    g = stage._bwd_params_only(
-                        stage.params, inputs, stage_rng, dy
-                    )
+                g = None
+                for _ in range(inner_iters):
+                    stage._fwd(stage.params, inputs, stage_rng)
+                    if stage._differentiable_inputs:
+                        g = stage._bwd(stage.params, inputs, stage_rng, dy)
+                    else:
+                        g = stage._bwd_params_only(
+                            stage.params, inputs, stage_rng, dy
+                        )
                 jax.block_until_ready(g)
-                samples.append(time.perf_counter() - t0)
+                samples.append(
+                    (time.perf_counter() - t0) / max(inner_iters, 1)
+                )
             times.append(float(np.median(samples)))
             acts = jax.tree_util.tree_map(np.asarray, out)
         return times

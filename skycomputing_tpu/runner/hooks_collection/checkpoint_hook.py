@@ -27,20 +27,24 @@ class CheckpointHook(Hook):
         load_checkpoint_from: Optional[str] = None,
         save_path: Optional[str] = None,
         save_interval: Optional[int] = None,
+        format: str = "msgpack",  # msgpack (single file) | orbax (directory)
     ):
+        if format not in ("msgpack", "orbax"):
+            raise ValueError(f"unknown checkpoint format {format!r}")
         self._load_checkpoint_from = load_checkpoint_from
         self._save_path = save_path
         self._save_interval = save_interval
+        self._format = format
 
     def before_run(self, runner):
         if self._load_checkpoint_from:
-            runner.parameter_server.load_weights_from_file(
-                self._load_checkpoint_from
-            )
+            src = self._load_checkpoint_from
+            if os.path.isdir(src):  # orbax checkpoints are directories
+                runner.parameter_server.load_orbax(src)
+            else:
+                runner.parameter_server.load_weights_from_file(src)
             runner.model.load_from_parameter_server()
-            runner.logger.info(
-                f"restored checkpoint from {self._load_checkpoint_from}"
-            )
+            runner.logger.info(f"restored checkpoint from {src}")
 
     def after_epoch(self, runner):
         if not self._save_path or not self._save_interval:
@@ -51,8 +55,12 @@ class CheckpointHook(Hook):
         runner.model.sync_to_parameter_server()
         # after_epoch runs after the runner increments epoch, so runner.epoch
         # is already the 1-based count of completed epochs
-        path = osp.join(self._save_path, f"epoch_{runner.epoch}.msgpack")
-        runner.parameter_server.save_weights_to_file(path)
+        if self._format == "orbax":
+            path = osp.join(self._save_path, f"epoch_{runner.epoch}")
+            runner.parameter_server.save_orbax(path)
+        else:
+            path = osp.join(self._save_path, f"epoch_{runner.epoch}.msgpack")
+            runner.parameter_server.save_weights_to_file(path)
         runner.logger.info(f"saved checkpoint to {path}")
 
 

@@ -1,0 +1,36 @@
+"""GLUE metric functions."""
+
+import numpy as np
+import pytest
+
+from skycomputing_tpu.ops.metrics import (
+    accuracy,
+    compute_task_metrics,
+    f1_score,
+    matthews_corrcoef,
+)
+
+
+def test_accuracy():
+    assert accuracy([0, 1, 2, 1], [0, 1, 1, 1]) == pytest.approx(0.75)
+
+
+def test_f1():
+    # tp=2, fp=1, fn=1 -> f1 = 4/6
+    assert f1_score([1, 1, 1, 0, 0], [1, 1, 0, 1, 0]) == pytest.approx(2 / 3)
+    assert np.isnan(f1_score([0, 0], [0, 0]))
+
+
+def test_matthews():
+    assert matthews_corrcoef([1, 0, 1, 0], [1, 0, 1, 0]) == pytest.approx(1.0)
+    assert matthews_corrcoef([0, 1, 0, 1], [1, 0, 1, 0]) == pytest.approx(-1.0)
+    assert matthews_corrcoef([1, 1, 1, 1], [1, 0, 1, 0]) == 0.0
+
+
+def test_task_dispatch():
+    m = compute_task_metrics("mrpc", [1, 0, 1], [1, 1, 1])
+    assert set(m) == {"accuracy", "f1"}
+    m = compute_task_metrics("cola", [1, 0], [1, 0])
+    assert set(m) == {"matthews"}
+    m = compute_task_metrics("unknown-task", [1], [1])
+    assert set(m) == {"accuracy"}

@@ -125,6 +125,30 @@ def test_checkpoint_hook_saves_and_restores(devices, tmp_path):
     )
 
 
+def test_orbax_checkpoint_roundtrip_across_partitions(devices, tmp_path):
+    """Orbax format: save from a 3-way world, restore into 2-way."""
+    model, ps, wm, loader = build_world(devices, seed=5)
+    runner = Runner(model, ps, wm, max_epochs=1, max_iters=2)
+    save_dir = str(tmp_path / "ockpts")
+    runner.register_hook(CheckpointHook(save_path=save_dir, save_interval=1,
+                                        format="orbax"))
+    runner.train(_BatchAdapter(loader))
+    ckpt = osp.join(save_dir, "epoch_1")
+    assert osp.isdir(ckpt)
+
+    model2, ps2, wm2, loader2 = build_world(devices, n_workers=2, seed=6)
+    runner2 = Runner(model2, ps2, wm2, max_epochs=0, max_iters=0)
+    runner2.register_hook(CheckpointHook(load_checkpoint_from=ckpt))
+    runner2.train(_BatchAdapter(loader2))
+
+    batch = next(iter(_BatchAdapter(loader)))
+    np.testing.assert_allclose(
+        np.asarray(model.forward(batch[0])),
+        np.asarray(model2.forward(batch[0])),
+        rtol=2e-4, atol=2e-5,
+    )
+
+
 def test_checkpoint_every_n_epochs_exact(devices, tmp_path):
     """save_interval=2, max_epochs=4 -> epoch_2 and epoch_4, not 1/3."""
     model, ps, wm, loader = build_world(devices)
