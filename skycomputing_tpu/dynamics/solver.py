@@ -247,14 +247,102 @@ def solve_contiguous_minmax(
             lo = mid
 
     order, slices = best
-    # Report the achieved bottleneck of the found assignment (tighter than T).
-    achieved = 0.0
-    for d, (s, e) in zip(order, slices):
-        achieved = max(
-            achieved,
-            table.device_time[d] * (table.cost_prefix[e] - table.cost_prefix[s]),
-        )
+    if D > exact_limit:
+        # greedy solutions deserve a polish: boundary moves + device swaps
+        order, slices = _local_search(table, order, slices)
+    achieved = _bottleneck(table, order, slices)
     return PartitionResult(order, slices, achieved)
+
+
+def _bottleneck(table: _CoverTable, order, slices) -> float:
+    worst = 0.0
+    for d, (s, e) in zip(order, slices):
+        worst = max(
+            worst,
+            table.device_time[d]
+            * (table.cost_prefix[e] - table.cost_prefix[s]),
+        )
+    return worst
+
+
+def _local_search(table: _CoverTable, order, slices, max_rounds: int = 200):
+    """Hill-climb on the greedy assignment: shift slice boundaries by one
+    layer and swap device positions while the bottleneck improves.
+
+    The exact DP path doesn't need this; the randomized greedy for large
+    clusters leaves a few percent on the table that these two moves — the
+    classic neighborhood for contiguous-partition scheduling — recover.
+    """
+    order = list(order)
+    slices = [list(s) for s in slices]
+
+    def stage_time(i) -> float:
+        d = order[i]
+        s, e = slices[i]
+        return table.device_time[d] * (
+            table.cost_prefix[e] - table.cost_prefix[s]
+        )
+
+    def mem_ok(i) -> bool:
+        s, e = slices[i]
+        return (
+            table.mem_prefix[e] - table.mem_prefix[s]
+            <= table.device_mem[order[i]] + 1e-9
+        )
+
+    n = len(order)
+    for _ in range(max_rounds):
+        times = [stage_time(i) for i in range(n)]
+        worst = max(range(n), key=lambda i: times[i])
+        current = times[worst]
+        improved = False
+
+        # move one boundary layer off the bottleneck stage to a neighbor
+        for nb, take_from in ((worst - 1, "left"), (worst + 1, "right")):
+            if not (0 <= nb < n):
+                continue
+            s, e = slices[worst]
+            if e - s <= 1:
+                continue
+            old_worst, old_nb = list(slices[worst]), list(slices[nb])
+            if take_from == "left" and nb == worst - 1:
+                slices[worst][0] += 1
+                slices[nb][1] += 1
+            elif take_from == "right" and nb == worst + 1:
+                slices[worst][1] -= 1
+                slices[nb][0] -= 1
+            else:  # pragma: no cover
+                continue
+            if (
+                mem_ok(worst)
+                and mem_ok(nb)
+                and max(stage_time(worst), stage_time(nb)) < current - 1e-15
+            ):
+                improved = True
+                break
+            slices[worst], slices[nb] = old_worst, old_nb
+
+        if improved:
+            continue
+
+        # swap the bottleneck device with any other position
+        for j in range(n):
+            if j == worst:
+                continue
+            order[worst], order[j] = order[j], order[worst]
+            if (
+                mem_ok(worst)
+                and mem_ok(j)
+                and max(stage_time(worst), stage_time(j)) < current - 1e-15
+            ):
+                improved = True
+                break
+            order[worst], order[j] = order[j], order[worst]
+
+        if not improved:
+            break
+
+    return order, [tuple(s) for s in slices]
 
 
 __all__ = ["solve_contiguous_minmax", "PartitionResult"]

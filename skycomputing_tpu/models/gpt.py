@@ -1,0 +1,261 @@
+"""GPT-style causal LM decomposed into pipeline-splittable units.
+
+The reference ships only BERT and ResNet zoos; this family demonstrates the
+framework's generality on decoder-only models using the exact same
+registry/LayerStack/allocator machinery.  Decomposition mirrors the BERT
+zoo's granularity so profiling and allocation work identically:
+
+==========================  =======================================  ==================
+registered name             inputs                                   outputs
+==========================  =======================================  ==================
+``GptEmbeddings``           (input_ids,)                             hidden
+``GptBlock_Attn``           hidden                                   hidden
+``GptBlock_Mlp``            hidden                                   hidden
+``GptLmHead``               hidden                                   logits [B, L, V]
+==========================  =======================================  ==================
+
+TPU-first details: pre-LayerNorm blocks, causal attention with a float32
+softmax (optionally ring attention over an 'sp' mesh for long context),
+bfloat16 compute, weight-tied LM head optional.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from ..registry import LAYER
+from .bert import ACT2FN
+
+
+class GptConfig:
+    def __init__(
+        self,
+        vocab_size: int = 50257,
+        hidden_size: int = 768,
+        num_hidden_layers: int = 12,
+        num_attention_heads: int = 12,
+        intermediate_size: Optional[int] = None,
+        max_position_embeddings: int = 1024,
+        hidden_act: str = "gelu",
+        dropout_prob: float = 0.1,
+        initializer_range: float = 0.02,
+        dtype: str = "bfloat16",
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.max_position_embeddings = max_position_embeddings
+        self.hidden_act = hidden_act
+        self.dropout_prob = dropout_prob
+        self.initializer_range = initializer_range
+        self.dtype = dtype
+
+    @classmethod
+    def from_dict(cls, data) -> "GptConfig":
+        if isinstance(data, GptConfig):
+            return data
+        data = dict(data)
+        import inspect
+
+        known = set(inspect.signature(cls.__init__).parameters) - {"self"}
+        # route known keys through __init__ so derived defaults (e.g.
+        # intermediate_size = 4*hidden_size) are computed from the dict's
+        # values, not the class defaults
+        cfg = cls(**{k: v for k, v in data.items() if k in known})
+        for k, v in data.items():
+            if k not in known:
+                setattr(cfg, k, v)
+        return cfg
+
+    def to_dict(self) -> dict:
+        return dict(self.__dict__)
+
+
+def _gcfg(config) -> GptConfig:
+    return GptConfig.from_dict(config)
+
+
+def _gdense(cfg: GptConfig, features: int, name: str) -> nn.Dense:
+    return nn.Dense(
+        features,
+        dtype=jnp.dtype(cfg.dtype),
+        param_dtype=jnp.float32,
+        kernel_init=nn.initializers.normal(cfg.initializer_range),
+        name=name,
+    )
+
+
+@LAYER.register_module
+class GptEmbeddings(nn.Module):
+    """Token + learned position embeddings."""
+
+    config: Any
+    deterministic: bool = False
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = _gcfg(self.config)
+        dtype = jnp.dtype(cfg.dtype)
+        seq_len = input_ids.shape[1]
+        if seq_len > cfg.max_position_embeddings:
+            raise ValueError(
+                f"sequence length {seq_len} exceeds "
+                f"max_position_embeddings={cfg.max_position_embeddings}"
+            )
+        tok = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=dtype,
+            embedding_init=nn.initializers.normal(cfg.initializer_range),
+            name="wte",
+        )(input_ids)
+        pos = nn.Embed(
+            cfg.max_position_embeddings, cfg.hidden_size, dtype=dtype,
+            embedding_init=nn.initializers.normal(cfg.initializer_range),
+            name="wpe",
+        )(jnp.arange(seq_len, dtype=jnp.int32)[None, :])
+        hidden = tok + pos
+        return nn.Dropout(cfg.dropout_prob)(
+            hidden, deterministic=self.deterministic
+        )
+
+
+@LAYER.register_module
+class GptBlock_Attn(nn.Module):
+    """Pre-LN causal self-attention half of a transformer block."""
+
+    config: Any
+    deterministic: bool = False
+    mesh: Any = None  # optional 'sp' ring for long context
+    axis_name: str = "sp"
+
+    @nn.compact
+    def __call__(self, hidden):
+        cfg = _gcfg(self.config)
+        dtype = jnp.dtype(cfg.dtype)
+        n_heads = cfg.num_attention_heads
+        head_dim = cfg.hidden_size // n_heads
+
+        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln_1")(
+            hidden
+        ).astype(dtype)
+
+        def split_heads(t):
+            return t.reshape(t.shape[0], t.shape[1], n_heads, head_dim)
+
+        q = split_heads(_gdense(cfg, cfg.hidden_size, "q_proj")(x))
+        k = split_heads(_gdense(cfg, cfg.hidden_size, "k_proj")(x))
+        v = split_heads(_gdense(cfg, cfg.hidden_size, "v_proj")(x))
+
+        if self.mesh is not None:
+            from ..parallel.ring_attention import ring_attention
+
+            ctx = ring_attention(q, k, v, self.mesh,
+                                 axis_name=self.axis_name, causal=True)
+        else:
+            scores = jnp.einsum("blhd,bmhd->bhlm", q, k) / jnp.sqrt(
+                jnp.asarray(head_dim, dtype)
+            )
+            L = q.shape[1]
+            causal = jnp.tril(jnp.ones((L, L), bool))
+            scores = jnp.where(causal[None, None], scores, -jnp.inf)
+            probs = jax.nn.softmax(
+                scores.astype(jnp.float32), axis=-1
+            ).astype(dtype)
+            ctx = jnp.einsum("bhlm,bmhd->blhd", probs, v)
+
+        ctx = ctx.reshape(ctx.shape[0], ctx.shape[1], cfg.hidden_size)
+        out = _gdense(cfg, cfg.hidden_size, "c_proj")(ctx)
+        out = nn.Dropout(cfg.dropout_prob)(
+            out, deterministic=self.deterministic
+        )
+        return hidden + out
+
+
+@LAYER.register_module
+class GptBlock_Mlp(nn.Module):
+    """Pre-LN MLP half of a transformer block."""
+
+    config: Any
+    deterministic: bool = False
+
+    @nn.compact
+    def __call__(self, hidden):
+        cfg = _gcfg(self.config)
+        act = ACT2FN[cfg.hidden_act]
+        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln_2")(
+            hidden
+        ).astype(jnp.dtype(cfg.dtype))
+        x = act(_gdense(cfg, cfg.intermediate_size, "c_fc")(x))
+        x = _gdense(cfg, cfg.hidden_size, "c_proj")(x)
+        x = nn.Dropout(cfg.dropout_prob)(x, deterministic=self.deterministic)
+        return hidden + x
+
+
+@LAYER.register_module
+class GptLmHead(nn.Module):
+    """Final LayerNorm + vocabulary projection."""
+
+    config: Any
+    deterministic: bool = False
+
+    @nn.compact
+    def __call__(self, hidden):
+        cfg = _gcfg(self.config)
+        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln_f")(hidden)
+        logits = nn.Dense(
+            cfg.vocab_size,
+            dtype=jnp.dtype(cfg.dtype),
+            param_dtype=jnp.float32,
+            kernel_init=nn.initializers.normal(cfg.initializer_range),
+            name="lm_head",
+        )(x.astype(jnp.dtype(cfg.dtype)))
+        return logits.astype(jnp.float32)
+
+
+def gpt_layer_configs(
+    config: Any,
+    num_blocks: Optional[int] = None,
+    deterministic: bool = False,
+    mesh: Any = None,
+) -> list:
+    """Full layer-config list: embeddings + blocks x (attn, mlp) + LM head."""
+    cfg = _gcfg(config)
+    if num_blocks is None:
+        num_blocks = cfg.num_hidden_layers
+    blocks = []
+    for _ in range(num_blocks):
+        blocks.append(
+            dict(layer_type="GptBlock_Attn", config=cfg.to_dict(),
+                 deterministic=deterministic, mesh=mesh)
+        )
+        blocks.append(
+            dict(layer_type="GptBlock_Mlp", config=cfg.to_dict(),
+                 deterministic=deterministic)
+        )
+    return (
+        [dict(layer_type="GptEmbeddings", config=cfg.to_dict(),
+              deterministic=deterministic)]
+        + blocks
+        + [dict(layer_type="GptLmHead", config=cfg.to_dict(),
+                deterministic=deterministic)]
+    )
+
+
+# re-exported from the loss registry (registered there as "CausalLmLoss")
+from ..ops.losses import causal_lm_loss  # noqa: E402
+
+
+__all__ = [
+    "GptConfig",
+    "GptEmbeddings",
+    "GptBlock_Attn",
+    "GptBlock_Mlp",
+    "GptLmHead",
+    "gpt_layer_configs",
+    "causal_lm_loss",
+]

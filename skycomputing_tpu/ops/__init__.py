@@ -1,5 +1,5 @@
 from .flash_attention import flash_attention
-from .losses import build_loss, cross_entropy_loss, mse_loss
+from .losses import build_loss, causal_lm_loss, cross_entropy_loss, mse_loss
 from .metrics import (
     accuracy,
     compute_task_metrics,
@@ -9,6 +9,7 @@ from .metrics import (
 
 __all__ = [
     "build_loss",
+    "causal_lm_loss",
     "cross_entropy_loss",
     "mse_loss",
     "flash_attention",

@@ -21,6 +21,14 @@ def mse_loss(predictions, targets):
     return jnp.mean((predictions.astype(jnp.float32) - targets) ** 2)
 
 
+@LOSS.register_module(name="CausalLmLoss")
+def causal_lm_loss(logits, labels):
+    """Next-token cross entropy; labels are the (unshifted) input ids."""
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1].astype(jnp.float32), labels[:, 1:]
+    ).mean()
+
+
 def build_loss(loss_cfg: dict):
     cfg = dict(loss_cfg)
     name = cfg.pop("type")
@@ -30,4 +38,4 @@ def build_loss(loss_cfg: dict):
     return fn
 
 
-__all__ = ["cross_entropy_loss", "mse_loss", "build_loss"]
+__all__ = ["cross_entropy_loss", "mse_loss", "causal_lm_loss", "build_loss"]

@@ -1,5 +1,11 @@
 from .hooks import Hook
-from .hooks_collection import CheckpointHook, DistributedTimerHelperHook, StopHook
+from .hooks_collection import (
+    CheckpointHook,
+    DistributedTimerHelperHook,
+    EvalHook,
+    MetricsHook,
+    StopHook,
+)
 from .runner import Runner
 
 __all__ = [
@@ -7,5 +13,7 @@ __all__ = [
     "Runner",
     "CheckpointHook",
     "DistributedTimerHelperHook",
+    "EvalHook",
+    "MetricsHook",
     "StopHook",
 ]

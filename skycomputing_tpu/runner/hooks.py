@@ -1,4 +1,14 @@
-"""mmcv-style lifecycle hooks (parity: ``scaelum/runner/hooks.py:5-58``)."""
+"""mmcv-style lifecycle hooks (parity: ``scaelum/runner/hooks.py:5-58``).
+
+One deliberate departure from the mmcv/reference routing: the ``*_val_*``
+variants default to no-ops instead of falling through to the generic
+``before/after_epoch``/``iter`` handlers.  ``Runner.evaluate`` runs *inside*
+a training run (e.g. from ``EvalHook``), and with fallthrough every
+train-oriented hook would double-fire during eval — CheckpointHook would
+checkpoint twice per epoch, iteration counters would count eval batches.
+Hooks that want to act during evaluation override the val methods
+explicitly.
+"""
 
 from __future__ import annotations
 
@@ -26,25 +36,25 @@ class Hook:
         self.before_epoch(runner)
 
     def before_val_epoch(self, runner):
-        self.before_epoch(runner)
+        pass
 
     def after_train_epoch(self, runner):
         self.after_epoch(runner)
 
     def after_val_epoch(self, runner):
-        self.after_epoch(runner)
+        pass
 
     def before_train_iter(self, runner):
         self.before_iter(runner)
 
     def before_val_iter(self, runner):
-        self.before_iter(runner)
+        pass
 
     def after_train_iter(self, runner):
         self.after_iter(runner)
 
     def after_val_iter(self, runner):
-        self.after_iter(runner)
+        pass
 
     # NOTE: the Runner increments epoch/iter BEFORE dispatching after_*
     # hooks, so inside a hook these counters already equal the number of

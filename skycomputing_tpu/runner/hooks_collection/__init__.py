@@ -1,5 +1,13 @@
 from .checkpoint_hook import CheckpointHook
+from .eval_hook import EvalHook
+from .metrics_hook import MetricsHook
 from .stop_hook import StopHook
 from .timer_hook import DistributedTimerHelperHook
 
-__all__ = ["CheckpointHook", "StopHook", "DistributedTimerHelperHook"]
+__all__ = [
+    "CheckpointHook",
+    "EvalHook",
+    "MetricsHook",
+    "StopHook",
+    "DistributedTimerHelperHook",
+]

@@ -178,7 +178,8 @@ class Runner:
         self._call_hook("before_val_epoch")
         loss_sum = 0.0
         correct = 0
-        total = 0
+        num_predictions = 0
+        num_examples = 0
         for i, (data, labels) in enumerate(data_loader):
             if max_batches is not None and i >= max_batches:
                 break
@@ -193,15 +194,26 @@ class Runner:
             # its examples more than full batches do
             loss_sum += batch_loss * n
             logits_host = np.asarray(logits)
-            correct += int((logits_host.argmax(axis=-1) == labels).sum())
-            total += n
+            if logits_host.ndim == 3:
+                # token-level (causal LM): the logit at position t predicts
+                # token t+1, so compare shifted
+                preds = logits_host.argmax(axis=-1)[:, :-1]
+                targets = labels[:, 1:]
+                correct += int((preds == targets).sum())
+                num_predictions += targets.size
+            else:
+                correct += int((logits_host.argmax(axis=-1) == labels).sum())
+                num_predictions += n
+            num_examples += n
             self._call_hook("after_val_iter")
         self._call_hook("after_val_epoch")
         self.model.train(True)
         return {
-            "loss": loss_sum / total if total else float("nan"),
-            "accuracy": correct / total if total else float("nan"),
-            "num_examples": total,
+            "loss": loss_sum / num_examples if num_examples else float("nan"),
+            "accuracy": (
+                correct / num_predictions if num_predictions else float("nan")
+            ),
+            "num_examples": num_examples,
         }
 
 

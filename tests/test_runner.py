@@ -202,3 +202,27 @@ def test_eval_mode_forward_is_deterministic(devices):
 def test_build_hook_from_registry(tmp_path):
     hook = build_hook(dict(type="StopHook", root=str(tmp_path)))
     assert isinstance(hook, StopHook)
+
+
+def test_eval_and_metrics_hooks(devices, tmp_path):
+    import json
+
+    from skycomputing_tpu.runner import EvalHook, MetricsHook
+
+    model, ps, wm, loader = build_world(devices)
+    # loader yields 8 batches/epoch; allow both epochs to complete
+    runner = Runner(model, ps, wm, max_epochs=2, max_iters=16)
+    metrics_path = str(tmp_path / "metrics.jsonl")
+    runner.register_hook(EvalHook(_BatchAdapter(loader), interval=1,
+                                  max_batches=2))
+    runner.register_hook(MetricsHook(metrics_path))
+    runner.train(_BatchAdapter(loader))
+
+    assert len(runner.eval_history) == 2  # one eval per epoch
+    for m in runner.eval_history:
+        assert 0.0 <= m["accuracy"] <= 1.0
+
+    with open(metrics_path) as fh:
+        records = [json.loads(line) for line in fh]
+    assert len(records) == 16  # train iters only — eval iters not logged
+    assert all("loss" in r and "forward_s" in r for r in records)
