@@ -11,13 +11,20 @@ Environment overrides (all optional):
 - ``SKYTPU_LAYER_NUM``: encoder-trio repeat count (depth scaling)
 - ``SKYTPU_PRESET``: bert preset (tiny | base | large)
 - ``SKYTPU_MAX_ITERS`` / ``SKYTPU_BATCH_SIZE`` / ``SKYTPU_MICROBATCHES``
+- ``SKYTPU_MODEL``: bert (GLUE classification) | gpt (causal LM)
+- ``SKYTPU_SCHEDULE``: gpipe | 1f1b (microbatch schedule)
 - ``STIMULATE``: enable the heterogeneity stimulator (reference env flag)
 """
 
 import os
 import os.path as osp
 
-from skycomputing_tpu.models import bert_config, bert_layer_configs
+from skycomputing_tpu.models import (
+    GptConfig,
+    bert_config,
+    bert_layer_configs,
+    gpt_layer_configs,
+)
 
 # allocation type, valid values are optimal, even and dynamic
 ALLOCATE_TYPE = os.getenv("SKYTPU_ALLOCATE_TYPE", "even")
@@ -31,15 +38,28 @@ LAYER_NUM = int(os.getenv("SKYTPU_LAYER_NUM", "10"))
 
 PRESET = os.getenv("SKYTPU_PRESET", "large")
 BATCH_SIZE = int(os.getenv("SKYTPU_BATCH_SIZE", "32"))
-MAX_SEQ_LENGTH = 128
+MAX_SEQ_LENGTH = int(os.getenv("SKYTPU_SEQ_LEN", "128"))
 NUM_MICROBATCHES = int(os.getenv("SKYTPU_MICROBATCHES", "1"))
+MODEL = os.getenv("SKYTPU_MODEL", "bert")
+SCHEDULE = os.getenv("SKYTPU_SCHEDULE", "gpipe")
 
 __bert_cfg = bert_config(PRESET)
 
-# model config: 1 embeddings + LAYER_NUM encoder trios + pooler + classifier
-model_config = bert_layer_configs(
-    __bert_cfg, num_encoder_units=LAYER_NUM, num_classes=3
-)
+if MODEL == "gpt":
+    # causal LM: depth scales via LAYER_NUM transformer blocks
+    __gpt_cfg = GptConfig(
+        hidden_size=__bert_cfg.hidden_size,
+        num_attention_heads=__bert_cfg.num_attention_heads,
+        num_hidden_layers=LAYER_NUM,
+        max_position_embeddings=MAX_SEQ_LENGTH,
+        dtype=__bert_cfg.dtype,
+    )
+    model_config = gpt_layer_configs(__gpt_cfg, num_blocks=LAYER_NUM)
+else:
+    # BERT: 1 embeddings + LAYER_NUM encoder trios + pooler + classifier
+    model_config = bert_layer_configs(
+        __bert_cfg, num_encoder_units=LAYER_NUM, num_classes=3
+    )
 
 # log layout mirrors the reference experiment matrix
 __LOG_ROOT = osp.join(
@@ -63,35 +83,51 @@ worker_config = [
 ]
 
 # dataset: GLUE MNLI when SKYTPU_GLUE_DIR points at real data, else synthetic
-data_config = dict(
-    dataset_cfg=dict(
-        type="GlueDataset",
-        data_dir=os.getenv("SKYTPU_GLUE_DIR", ""),
-        vocab_file=os.getenv("SKYTPU_VOCAB_FILE", None),
-        max_seq_length=MAX_SEQ_LENGTH,
-        do_lower_case=False,
-        processor="mnli",
-    ),
-    dataloader_cfg=dict(
-        batch_size=BATCH_SIZE,
-        shuffle=True,
-    ),
-)
+if MODEL == "gpt":
+    data_config = dict(
+        dataset_cfg=dict(
+            type="RandomLmDataset",
+            seq_length=MAX_SEQ_LENGTH,
+            vocab_size=50257,
+        ),
+        dataloader_cfg=dict(batch_size=BATCH_SIZE, shuffle=True),
+    )
+else:
+    data_config = dict(
+        dataset_cfg=dict(
+            type="GlueDataset",
+            data_dir=os.getenv("SKYTPU_GLUE_DIR", ""),
+            vocab_file=os.getenv("SKYTPU_VOCAB_FILE", None),
+            max_seq_length=MAX_SEQ_LENGTH,
+            do_lower_case=False,
+            processor="mnli",
+        ),
+        dataloader_cfg=dict(batch_size=BATCH_SIZE, shuffle=True),
+    )
 
-# profiling + allocation
+# profiling + allocation: the model profiler's probe must match the model
+# family's input signature
+if MODEL == "gpt":
+    __model_probe_cfg = dict(
+        generator_type="DataloaderGenerator",
+        generator_cfg=dict(generator_cfg=data_config),
+    )
+else:
+    __model_probe_cfg = dict(
+        generator_type="RandomTokenGenerator",
+        generator_cfg=dict(
+            batch_size=BATCH_SIZE,
+            seq_length=MAX_SEQ_LENGTH,
+            vocab_size=__bert_cfg.vocab_size,
+        ),
+    )
+
 allocator_config = dict(
     type=ALLOCATE_TYPE,
     benchmark_config=dict(
         model=dict(
             param_scale=2,
-            data_generator_cfg=dict(
-                generator_type="RandomTokenGenerator",
-                generator_cfg=dict(
-                    batch_size=BATCH_SIZE,
-                    seq_length=MAX_SEQ_LENGTH,
-                    vocab_size=__bert_cfg.vocab_size,
-                ),
-            ),
+            data_generator_cfg=__model_probe_cfg,
         ),
         device=dict(
             # MXU-saturating matmul proxy (reference used 10x Conv2d)
@@ -110,7 +146,9 @@ allocator_config = dict(
 # training
 train_config = dict(
     optim_cfg=dict(optim_type="sgd", learning_rate=0.001),
-    loss_cfg=dict(type="CrossEntropyLoss"),
+    loss_cfg=dict(
+        type="CausalLmLoss" if MODEL == "gpt" else "CrossEntropyLoss"
+    ),
     runner_cfg=dict(
         max_epochs=int(os.getenv("SKYTPU_MAX_EPOCHS", "1")),
         max_iters=int(os.getenv("SKYTPU_MAX_ITERS", "30")),

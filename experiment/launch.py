@@ -60,10 +60,14 @@ def run(cfg, logger: Logger) -> int:
     data_loader = build_dataloader_from_cfg(cfg.data_config)
 
     def batches():
-        # GlueDataset rows are ((ids, mask, segs), label); BertEmbeddings
-        # takes (ids, token_type_ids, attention_mask)
-        for (ids, mask, segs), labels in data_loader:
-            yield (ids, segs, mask), labels
+        for data, labels in data_loader:
+            if len(data) == 3:
+                # GlueDataset rows are ((ids, mask, segs), label);
+                # BertEmbeddings takes (ids, token_type_ids, attention_mask)
+                ids, mask, segs = data
+                yield (ids, segs, mask), labels
+            else:
+                yield data, labels
 
     class BatchAdapter:
         def __len__(self):
@@ -133,6 +137,7 @@ def run(cfg, logger: Logger) -> int:
         build_loss(cfg.train_config["loss_cfg"]),
         devices=devices,
         num_microbatches=getattr(cfg, "NUM_MICROBATCHES", 1),
+        schedule=getattr(cfg, "SCHEDULE", "gpipe"),
     )
 
     runner = Runner(

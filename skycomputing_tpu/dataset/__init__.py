@@ -7,7 +7,12 @@ from .data_generator import (
     RandomTokenGenerator,
 )
 from .dataloader import DataLoader
-from .datasets import RandomBertDataset, RandomImageDataset, RandomMlpDataset
+from .datasets import (
+    RandomBertDataset,
+    RandomImageDataset,
+    RandomLmDataset,
+    RandomMlpDataset,
+)
 from . import glue
 
 __all__ = [
@@ -20,6 +25,7 @@ __all__ = [
     "DataLoader",
     "RandomBertDataset",
     "RandomImageDataset",
+    "RandomLmDataset",
     "RandomMlpDataset",
     "glue",
 ]

@@ -76,4 +76,35 @@ class RandomBertDataset:
         )
 
 
-__all__ = ["RandomMlpDataset", "RandomImageDataset", "RandomBertDataset"]
+@DATASET.register_module
+class RandomLmDataset:
+    """Synthetic causal-LM rows: ((input_ids,), input_ids).
+
+    Labels ARE the input ids (the loss shifts internally), with a repeated
+    n-gram structure so a working LM visibly drives the loss toward zero.
+    """
+
+    def __init__(self, num_samples: int = 512, seq_length: int = 128,
+                 vocab_size: int = 50257, ngram: int = 8, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        reps = (seq_length + ngram - 1) // ngram
+        rows = []
+        for _ in range(num_samples):
+            pattern = rng.integers(0, vocab_size, size=(ngram,), dtype=np.int32)
+            rows.append(np.tile(pattern, reps)[:seq_length])
+        self.input_ids = np.stack(rows)
+
+    def __len__(self):
+        return len(self.input_ids)
+
+    def __getitem__(self, idx):
+        row = self.input_ids[idx]
+        return (row,), row
+
+
+__all__ = [
+    "RandomMlpDataset",
+    "RandomImageDataset",
+    "RandomBertDataset",
+    "RandomLmDataset",
+]

@@ -235,12 +235,19 @@ class StageRuntime:
 
 @dataclass
 class PipelineStats:
-    """Wall-clock phase accounting for the last step."""
+    """Wall-clock phase accounting for the last step.
+
+    Under the 1F1B schedule forward and backward interleave, so their split
+    is not observable: ``forward_s`` then holds the fused fwd+bwd time,
+    ``backward_s`` is 0, and ``interleaved`` is True so consumers (logs,
+    MetricsHook) can tell fused from free.
+    """
 
     forward_s: float = 0.0
     backward_s: float = 0.0
     step_s: float = 0.0
     loss: float = 0.0
+    interleaved: bool = False
 
 
 class PipelineModel:
@@ -261,13 +268,17 @@ class PipelineModel:
         loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
         devices: Optional[Sequence[Any]] = None,
         num_microbatches: int = 1,
+        schedule: str = "gpipe",
     ):
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown schedule {schedule!r}")
         self._worker_manager = worker_manager
         self._parameter_server = parameter_server
         self._optimizer = optimizer
         self._loss_fn = loss_fn
         self._devices = list(devices) if devices is not None else jax.devices()
         self.num_microbatches = num_microbatches
+        self.schedule = schedule
         self.stats = PipelineStats()
         self._train = True
 
@@ -370,8 +381,13 @@ class PipelineModel:
         Returns the mean loss over the batch.  Dispatch is asynchronous: with
         M microbatches the stages overlap GPipe-style without any explicit
         schedule — each device's work queue serializes its own stage while
-        transfers ride ICI in parallel.
+        transfers ride ICI in parallel.  With ``schedule="1f1b"`` each
+        microbatch's backward is issued as soon as its forward clears the
+        last stage, capping per-stage live inputs at the pipeline depth
+        instead of M.
         """
+        if self.schedule == "1f1b" and self.num_microbatches > 1:
+            return self._train_step_1f1b(data, labels, rng)
         if rng is None:
             rng = jax.random.key(int(time.time_ns() % (2**31)))
         M = self.num_microbatches
@@ -432,6 +448,124 @@ class PipelineModel:
         self.stats = PipelineStats(
             forward_s=t1 - t0, backward_s=t2 - t1, step_s=t3 - t2,
             loss=total_loss,
+        )
+        return total_loss
+
+    def _train_step_1f1b(self, data, labels, rng) -> float:
+        """One-forward-one-backward schedule: issue each microbatch's
+        backward as soon as its forward drains the last stage.
+
+        Host-side this is a dependency-driven issue loop over per-stage op
+        queues (warmup fwds, then alternating B/F, then drain), the classic
+        non-interleaved 1F1B.  A stage's stored input for microbatch m is
+        freed when its backward is issued, so live activations per stage
+        are bounded by the pipeline depth rather than M.
+        """
+        if rng is None:
+            rng = jax.random.key(int(time.time_ns() % (2**31)))
+        M = self.num_microbatches
+        S = len(self.stages)
+        micro_data = _split_microbatches(as_tuple(data), M)
+        micro_labels = _split_microbatches(labels, M)
+        scale = 1.0 / M
+
+        rngs = [
+            [jax.random.fold_in(jax.random.fold_in(rng, m), k)
+             for k in range(S)]
+            for m in range(M)
+        ]
+
+        t0 = time.perf_counter()
+        # live state
+        stage_inputs: List[Dict[int, Tuple]] = [dict() for _ in range(S)]
+        stage_outputs: List[Dict[int, Tuple]] = [dict() for _ in range(S)]
+        dys: List[Dict[int, Tuple]] = [dict() for _ in range(S)]
+        grad_totals: List[Any] = [None] * S
+        losses: List[Any] = []
+        fwd_next = [0] * S  # next microbatch each stage will forward
+        bwd_next = [0] * S  # next microbatch each stage will backward
+
+        def can_fwd(k):
+            m = fwd_next[k]
+            if m >= M:
+                return False
+            return k == 0 or m in stage_outputs[k - 1]
+
+        def can_bwd(k):
+            m = bwd_next[k]
+            if m >= M or m not in stage_inputs[k]:
+                return False
+            # cotangent source: own fwd's dlogits for the last stage,
+            # the next stage's input-cotangent otherwise
+            return m in (dys[k] if k == S - 1 else dys[k + 1])
+
+        def do_fwd(k):
+            m = fwd_next[k]
+            stage = self.stages[k]
+            acts = (
+                micro_data[m] if k == 0 else stage_outputs[k - 1].pop(m)
+            )
+            acts = jax.device_put(acts, stage.device)
+            stage_inputs[k][m] = acts
+            out = stage.forward(acts, rngs[m][k])
+            if k < S - 1:
+                stage_outputs[k][m] = out
+            else:
+                labels_m = jax.device_put(micro_labels[m], self._last_device)
+                loss_m, dlogits = self._loss_and_dlogits(
+                    out[0], labels_m, scale
+                )
+                losses.append(loss_m)
+                dys[k][m] = (dlogits,) + tuple(
+                    jnp.zeros_like(x) for x in out[1:]
+                )
+            fwd_next[k] += 1
+
+        def do_bwd(k):
+            m = bwd_next[k]
+            stage = self.stages[k]
+            dy = dys[k].pop(m) if k == S - 1 else dys[k + 1].pop(m)
+            grads, dx = stage.backward(
+                stage_inputs[k].pop(m), rngs[m][k], dy
+            )
+            grad_totals[k] = stage.accumulate(grad_totals[k], grads)
+            if k > 0:
+                dys[k][m] = dx
+            bwd_next[k] += 1
+
+        # issue loop: walk stages last-to-first preferring backwards (they
+        # free memory), then first-to-last issuing forwards; every pass
+        # makes progress until all backwards are issued
+        while any(b < M for b in bwd_next):
+            progressed = False
+            for k in reversed(range(S)):
+                if can_bwd(k):
+                    # classic 1F1B warmup: stage k delays its first backward
+                    # until S-1-k forwards are in flight or forwards are done
+                    if (
+                        fwd_next[k] - bwd_next[k] >= min(S - k, M - bwd_next[k])
+                        or fwd_next[k] >= M
+                    ):
+                        do_bwd(k)
+                        progressed = True
+            for k in range(S):
+                if can_fwd(k):
+                    do_fwd(k)
+                    progressed = True
+            if not progressed:  # pragma: no cover - schedule deadlock guard
+                raise RuntimeError("1F1B schedule made no progress")
+
+        jax.block_until_ready(grad_totals[0])
+        t2 = time.perf_counter()
+        for k, stage in enumerate(self.stages):
+            stage.apply_gradients(grad_totals[k])
+        jax.block_until_ready(self.stages[0].params)
+        t3 = time.perf_counter()
+
+        total_loss = float(sum(jax.device_get(l) for l in losses))
+        self.stats = PipelineStats(
+            forward_s=t2 - t0, backward_s=0.0, step_s=t3 - t2,
+            loss=total_loss, interleaved=True,
         )
         return total_loss
 

@@ -4,7 +4,9 @@ from .hooks_collection import (
     DistributedTimerHelperHook,
     EvalHook,
     MetricsHook,
+    NanGuardHook,
     StopHook,
+    WatchdogHook,
 )
 from .runner import Runner
 
@@ -15,5 +17,7 @@ __all__ = [
     "DistributedTimerHelperHook",
     "EvalHook",
     "MetricsHook",
+    "NanGuardHook",
     "StopHook",
+    "WatchdogHook",
 ]

@@ -3,11 +3,14 @@ from .eval_hook import EvalHook
 from .metrics_hook import MetricsHook
 from .stop_hook import StopHook
 from .timer_hook import DistributedTimerHelperHook
+from .watchdog_hook import NanGuardHook, WatchdogHook
 
 __all__ = [
     "CheckpointHook",
     "EvalHook",
     "MetricsHook",
+    "NanGuardHook",
     "StopHook",
     "DistributedTimerHelperHook",
+    "WatchdogHook",
 ]

@@ -21,25 +21,19 @@ class EvalHook(Hook):
         self._data_loader = data_loader
         self._interval = interval
         self._max_batches = max_batches
-        self._evaluating = False
 
     def before_run(self, runner):
         if not hasattr(runner, "eval_history"):
             runner.eval_history = []
 
     def after_epoch(self, runner):
-        # evaluate() dispatches val-lifecycle hooks, and the Hook base
-        # routes after_val_epoch back to after_epoch — guard re-entry
-        if self._evaluating:
-            return
+        # safe to call evaluate() from here: the Hook base deliberately
+        # does NOT route val-lifecycle events to the generic handlers (see
+        # hooks.py module docstring), so no re-entry can occur
         if not self.every_n_epochs(runner, self._interval):
             return
-        self._evaluating = True
-        try:
-            metrics = runner.evaluate(self._data_loader,
-                                      max_batches=self._max_batches)
-        finally:
-            self._evaluating = False
+        metrics = runner.evaluate(self._data_loader,
+                                  max_batches=self._max_batches)
         metrics["epoch"] = runner.epoch
         runner.eval_history.append(metrics)
         runner.logger.info(

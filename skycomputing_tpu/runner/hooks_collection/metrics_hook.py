@@ -42,6 +42,8 @@ class MetricsHook(Hook):
             "forward_s": stats.forward_s,
             "backward_s": stats.backward_s,
             "step_s": stats.step_s,
+            # under 1f1b forward_s holds the fused fwd+bwd time
+            "interleaved": stats.interleaved,
         }
         self._fh.write(json.dumps(record) + "\n")
         self._pending += 1

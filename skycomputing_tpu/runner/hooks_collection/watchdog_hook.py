@@ -1,0 +1,71 @@
+"""Failure detection hooks.
+
+The reference detects nothing — its only failure handling is an RPC timeout
+and a clean no-train exit on allocation errors (SURVEY §5).  These hooks add
+the two cheapest, highest-value detectors for long unattended runs:
+
+- ``NanGuardHook``: stop (or raise) the moment the loss goes non-finite,
+  instead of burning the rest of the schedule on garbage.
+- ``WatchdogHook``: flag iterations that exceed a wall-clock budget —
+  the single-controller analog of a peer-liveness check (a wedged device,
+  a stuck transfer, or interconnect trouble all surface as a slow step).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Optional
+
+from ...registry import HOOKS
+from ..hooks import Hook
+
+
+@HOOKS.register_module
+class NanGuardHook(Hook):
+    def __init__(self, action: str = "stop"):
+        if action not in ("stop", "raise"):
+            raise ValueError(f"unknown action {action!r}")
+        self._action = action
+
+    def after_iter(self, runner):
+        loss = runner.model.stats.loss
+        if math.isfinite(loss):
+            return
+        message = f"non-finite loss {loss} at iter {runner.iter}"
+        runner.logger.info(f"NanGuardHook: {message}")
+        if self._action == "raise":
+            raise FloatingPointError(message)
+        runner.request_stop()
+
+
+@HOOKS.register_module
+class WatchdogHook(Hook):
+    def __init__(self, max_iter_seconds: float, action: str = "log",
+                 grace_iters: int = 1):
+        if action not in ("log", "stop"):
+            raise ValueError(f"unknown action {action!r}")
+        self._budget = max_iter_seconds
+        self._action = action
+        # first iterations include compilation; give them a pass
+        self._grace_iters = grace_iters
+        self._started: Optional[float] = None
+
+    def before_iter(self, runner):
+        self._started = time.perf_counter()
+
+    def after_iter(self, runner):
+        if self._started is None:
+            return
+        elapsed = time.perf_counter() - self._started
+        if elapsed <= self._budget or runner.iter <= self._grace_iters:
+            return
+        runner.logger.info(
+            f"WatchdogHook: iter {runner.iter - 1} took {elapsed:.2f}s "
+            f"(budget {self._budget}s)"
+        )
+        if self._action == "stop":
+            runner.request_stop()
+
+
+__all__ = ["NanGuardHook", "WatchdogHook"]

@@ -123,7 +123,15 @@ class Runner:
         self.data_loader = data_loader
         self.model.train(True)
         self._call_hook("before_run")
+        try:
+            self._train_loop(data_loader)
+        finally:
+            # after_run must fire even when training raises (NanGuardHook
+            # action="raise", KeyboardInterrupt, ...): hooks flush files,
+            # close handles, clean timers
+            self._call_hook("after_run")
 
+    def _train_loop(self, data_loader) -> None:
         while self._epoch < self._max_epochs and not self._stop:
             self._call_hook("before_train_epoch")
             self._inner_iter = 0
@@ -146,11 +154,19 @@ class Runner:
                 self.phase_timer.record("forward", stats.forward_s)
                 self.phase_timer.record("backward", stats.backward_s)
                 self.phase_timer.record("step", stats.step_s)
-                self._logger.info(
-                    f"loss: {loss:.6f} | forward time: {stats.forward_s:.4f} | "
-                    f"backward time: {stats.backward_s:.4f} | "
-                    f"step time: {stats.step_s:.4f}"
-                )
+                if stats.interleaved:
+                    self._logger.info(
+                        f"loss: {loss:.6f} | fwd+bwd (fused, 1f1b): "
+                        f"{stats.forward_s:.4f} | step time: "
+                        f"{stats.step_s:.4f}"
+                    )
+                else:
+                    self._logger.info(
+                        f"loss: {loss:.6f} | forward time: "
+                        f"{stats.forward_s:.4f} | backward time: "
+                        f"{stats.backward_s:.4f} | step time: "
+                        f"{stats.step_s:.4f}"
+                    )
 
                 self._iter += 1
                 self._inner_iter += 1
@@ -160,8 +176,6 @@ class Runner:
             self._call_hook("after_train_epoch")
             if self._iter >= self._max_iters:
                 break
-
-        self._call_hook("after_run")
 
     # --- evaluation ----------------------------------------------------------
     def evaluate(self, data_loader, max_batches: Optional[int] = None) -> Dict:

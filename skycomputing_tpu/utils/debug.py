@@ -1,0 +1,28 @@
+"""Debug / sanitizer utilities.
+
+The reference has no sanitizers at all (SURVEY §5: no TSAN/ASAN, no anomaly
+detection).  The JAX-native equivalents are compiler-level checks: NaN
+trapping inside jitted programs and disabling jit for pdb-able execution.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+def enable_nan_checks(enable: bool = True) -> None:
+    """Trap NaNs at the op level inside jitted code (recompiles affected
+    programs; debug-only — it disables some fusion)."""
+    jax.config.update("jax_debug_nans", enable)
+
+
+@contextlib.contextmanager
+def no_jit():
+    """Run the enclosed block op-by-op (breakpointable, slow)."""
+    with jax.disable_jit():
+        yield
+
+
+__all__ = ["enable_nan_checks", "no_jit"]

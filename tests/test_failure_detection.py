@@ -1,0 +1,69 @@
+"""NanGuard and Watchdog hooks."""
+
+import time
+
+import numpy as np
+import pytest
+
+from skycomputing_tpu.runner import NanGuardHook, Runner, WatchdogHook
+from tests.test_runner import _BatchAdapter, build_world
+
+
+def test_nan_guard_stops_run(devices):
+    model, ps, wm, loader = build_world(devices)
+    runner = Runner(model, ps, wm, max_epochs=10, max_iters=100)
+    runner.register_hook(NanGuardHook(action="stop"))
+
+    real_step = model.train_step
+
+    def poisoned_step(data, labels, rng=None):
+        real_step(data, labels, rng=rng)
+        if runner.iter >= 2:
+            model.stats.loss = float("nan")
+        return model.stats.loss
+
+    model.train_step = poisoned_step
+    runner.train(_BatchAdapter(loader))
+    assert runner.iter == 3  # iter index 2 went NaN; stopped right after
+
+
+def test_nan_guard_raise_action(devices):
+    model, ps, wm, loader = build_world(devices)
+    runner = Runner(model, ps, wm, max_epochs=1, max_iters=5)
+    runner.register_hook(NanGuardHook(action="raise"))
+    real_step = model.train_step
+
+    def poisoned_step(data, labels, rng=None):
+        real_step(data, labels, rng=rng)
+        model.stats.loss = float("inf")
+        return model.stats.loss
+
+    model.train_step = poisoned_step
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        runner.train(_BatchAdapter(loader))
+
+
+def test_watchdog_flags_slow_iterations(devices):
+    model, ps, wm, loader = build_world(devices)
+    runner = Runner(model, ps, wm, max_epochs=10, max_iters=50)
+    runner.register_hook(
+        WatchdogHook(max_iter_seconds=0.05, action="stop", grace_iters=2)
+    )
+    real_step = model.train_step
+
+    def slow_step(data, labels, rng=None):
+        out = real_step(data, labels, rng=rng)
+        if runner.iter >= 2:
+            time.sleep(0.2)
+        return out
+
+    model.train_step = slow_step
+    runner.train(_BatchAdapter(loader))
+    assert runner.iter < 50  # stopped early
+
+
+def test_bad_actions_rejected():
+    with pytest.raises(ValueError):
+        NanGuardHook(action="explode")
+    with pytest.raises(ValueError):
+        WatchdogHook(1.0, action="panic")

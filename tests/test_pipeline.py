@@ -135,6 +135,28 @@ def test_microbatched_equals_full_batch_grads(devices):
             )
 
 
+def test_1f1b_matches_gpipe(devices):
+    """1F1B issue order must produce identical training to GPipe."""
+    gp, data, labels, _ = build_pipeline(devices, n_workers=4,
+                                         num_microbatches=4, seed=7)
+    import optax
+
+    from skycomputing_tpu.parallel import PipelineModel
+
+    # rebuild an identical world with the 1f1b schedule
+    ob, *_ = build_pipeline(devices, n_workers=4, num_microbatches=4, seed=7)
+    ob.schedule = "1f1b"
+
+    l_gp = gp.train_step(data, labels, rng=jax.random.key(0))
+    l_ob = ob.train_step(data, labels, rng=jax.random.key(0))
+    assert l_gp == pytest.approx(l_ob, rel=1e-5)
+    for a, b in zip(gp.stages, ob.stages):
+        for x, y in zip(jax.tree_util.tree_leaves(a.params),
+                        jax.tree_util.tree_leaves(b.params)):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=1e-5, atol=1e-7)
+
+
 def test_checkpoint_survives_reallocation(devices, tmp_path):
     """Train 4-way, checkpoint, restore into a 2-way pipeline, same logits."""
     model, data, labels, ps = build_pipeline(devices, n_workers=4)
