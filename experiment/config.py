@@ -11,6 +11,7 @@ Environment overrides (all optional):
 - ``SKYTPU_LAYER_NUM``: encoder-trio repeat count (depth scaling)
 - ``SKYTPU_PRESET``: bert preset (tiny | base | large)
 - ``SKYTPU_MAX_ITERS`` / ``SKYTPU_BATCH_SIZE`` / ``SKYTPU_MICROBATCHES``
+- ``SKYTPU_SEQ_LEN``: sequence length (default 128)
 - ``SKYTPU_MODEL``: bert (GLUE classification) | gpt (causal LM)
 - ``SKYTPU_SCHEDULE``: gpipe | 1f1b (microbatch schedule)
 - ``STIMULATE``: enable the heterogeneity stimulator (reference env flag)

@@ -43,7 +43,12 @@ class ParameterServer:
                 rng = jax.random.key(0)
             # keep the master copy on host memory, off the accelerators
             with jax.default_device(jax.devices("cpu")[0]):
-                self.params = self.stack.init(rng, *example_inputs)
+                params = self.stack.init(rng, *example_inputs)
+            # true numpy copies: stage runtimes donate their device buffers
+            # on every update, and device_put to a same-device destination
+            # aliases rather than copies — the master copy must never share
+            # storage with anything donatable
+            self.params = jax.tree_util.tree_map(np.array, params)
 
     @property
     def num_layers(self) -> int:
@@ -106,7 +111,9 @@ class ParameterServer:
 
     # --- per-layer exchange with stages ------------------------------------
     def update_weights(self, state: Any, idx: int) -> None:
-        self.params[idx] = jax.tree_util.tree_map(np.asarray, state)
+        # np.array (not asarray): same-device views would alias donatable
+        # stage buffers
+        self.params[idx] = jax.tree_util.tree_map(np.array, state)
 
     def get_state_dict(self, idx: int) -> Any:
         return self.params[idx]

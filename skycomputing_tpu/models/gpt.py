@@ -250,6 +250,57 @@ def gpt_layer_configs(
 from ..ops.losses import causal_lm_loss  # noqa: E402
 
 
+def generate(
+    forward_fn,
+    prompt,
+    max_new_tokens: int,
+    context_length: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    pad_id: int = 0,
+):
+    """Autoregressive decoding against any fixed-shape forward function.
+
+    ``forward_fn(input_ids) -> logits [B, L, V]`` — e.g.
+    ``lambda ids: pipeline_model.forward((ids,))`` or a jitted monolithic
+    apply.  The prompt is right-padded to ``context_length`` so the forward
+    keeps one compiled shape; greedy when ``temperature == 0``, else
+    categorical sampling.
+    """
+    import numpy as np
+
+    prompt = np.asarray(prompt)
+    if prompt.ndim == 1:
+        prompt = prompt[None]
+    batch, start_len = prompt.shape
+    if start_len + max_new_tokens > context_length:
+        raise ValueError(
+            f"prompt ({start_len}) + new tokens ({max_new_tokens}) exceed "
+            f"context_length={context_length}"
+        )
+
+    tokens = np.full((batch, context_length), pad_id, dtype=np.int32)
+    tokens[:, :start_len] = prompt
+    length = start_len
+    for step in range(max_new_tokens):
+        logits = np.asarray(forward_fn(tokens))
+        next_logits = logits[:, length - 1]
+        if temperature <= 0.0:
+            nxt = next_logits.argmax(axis=-1)
+        else:
+            if rng is None:
+                rng = jax.random.key(0)
+            rng, sub = jax.random.split(rng)
+            nxt = np.asarray(
+                jax.random.categorical(
+                    sub, jnp.asarray(next_logits) / temperature, axis=-1
+                )
+            )
+        tokens[:, length] = nxt.astype(np.int32)
+        length += 1
+    return tokens[:, :length]
+
+
 __all__ = [
     "GptConfig",
     "GptEmbeddings",
@@ -258,4 +309,5 @@ __all__ = [
     "GptLmHead",
     "gpt_layer_configs",
     "causal_lm_loss",
+    "generate",
 ]

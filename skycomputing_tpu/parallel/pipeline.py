@@ -136,7 +136,10 @@ class _StagePrograms:
         self.bwd = jax.jit(bwd)
         self.bwd_params_only = jax.jit(bwd_params_only)
         self.grad_add = jax.jit(grad_add)
-        self.update = jax.jit(update)
+        # donate the old params/opt_state: the caller rebinds both to the
+        # update's outputs, so XLA can update buffers in place instead of
+        # holding two copies of every stage's parameters during the step
+        self.update = jax.jit(update, donate_argnums=(0, 1))
 
 
 def get_stage_programs(layer_cfgs, optimizer) -> _StagePrograms:

@@ -81,6 +81,39 @@ def test_gpt_ring_attention_matches_dense(devices):
     np.testing.assert_allclose(out_dense, out_ring, rtol=3e-4, atol=3e-5)
 
 
+def test_generate_greedy_recovers_pattern(devices):
+    from skycomputing_tpu.dynamics import (
+        Allocator,
+        ParameterServer,
+        WorkerManager,
+    )
+    from skycomputing_tpu.models.gpt import generate
+    from skycomputing_tpu.parallel import PipelineModel
+
+    layer_cfgs, cfg = tiny_gpt()
+    wm = WorkerManager()
+    wm.load_worker_pool_from_config(
+        [dict(name=f"n{i}", device_config=dict(device_index=i),
+              extra_config={}) for i in range(2)]
+    )
+    Allocator(layer_cfgs, wm, None, None).even_allocate()
+    pattern = np.tile(np.array([3, 7, 11, 5], np.int32), 8)[None].repeat(8, 0)
+    ps = ParameterServer(layer_cfgs, example_inputs=(pattern,))
+    model = PipelineModel(wm, ps, optax.adam(3e-3), causal_lm_loss,
+                          devices=devices)
+    for i in range(50):
+        model.train_step((pattern,), pattern, rng=jax.random.key(i))
+
+    out = generate(lambda ids: model.forward((ids,)),
+                   np.array([3, 7], np.int32), max_new_tokens=6,
+                   context_length=32)
+    assert out[0].tolist() == [3, 7, 11, 5, 3, 7, 11, 5]
+
+    with pytest.raises(ValueError, match="exceed"):
+        generate(lambda ids: model.forward((ids,)),
+                 np.arange(30, dtype=np.int32), 6, 32)
+
+
 def test_gpt_profiles_through_model_benchmarker():
     from skycomputing_tpu.dataset import BaseGenerator
     from skycomputing_tpu.dynamics import ModelBenchmarker
