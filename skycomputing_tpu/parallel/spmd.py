@@ -50,8 +50,34 @@ from ..models.bert import (
 from ..models.bert_config import BertConfig
 
 
+class EncoderUnit(nn.Module):
+    """One full encoder trio (attention + FFN)."""
+
+    config: Any
+
+    @nn.compact
+    def __call__(self, hidden, mask):
+        hidden, mask = BertLayer_Head(self.config, True, name="head")(
+            hidden, mask
+        )
+        inter, attn, mask = BertLayer_Body(self.config, True, name="body")(
+            hidden, mask
+        )
+        hidden, mask = BertLayer_Tail(self.config, True, name="tail")(
+            inter, attn, mask
+        )
+        return hidden, mask
+
+
 class EncoderStage(nn.Module):
-    """``units`` encoder trios = one uniform pipeline stage."""
+    """``units`` encoder trios = one uniform pipeline stage.
+
+    Each unit is rematerialized: through the GPipe scan the backward pass
+    otherwise stores every tick's intermediate activations (attention
+    scores context, FFN up-projection); with remat only each unit's input
+    survives to the backward, bounding per-tick residency at one hidden
+    block per unit.
+    """
 
     config: Any
     units: int
@@ -59,15 +85,9 @@ class EncoderStage(nn.Module):
     @nn.compact
     def __call__(self, hidden, mask):
         for u in range(self.units):
-            hidden, mask = nn.remat(BertLayer_Head)(
-                self.config, True, name=f"head_{u}"
+            hidden, mask = nn.remat(EncoderUnit)(
+                self.config, name=f"unit_{u}"
             )(hidden, mask)
-            inter, attn, mask = BertLayer_Body(
-                self.config, True, name=f"body_{u}"
-            )(hidden, mask)
-            hidden, mask = BertLayer_Tail(
-                self.config, True, name=f"tail_{u}"
-            )(inter, attn, mask)
         return hidden, mask
 
 

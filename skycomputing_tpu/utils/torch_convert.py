@@ -1,0 +1,121 @@
+"""Convert reference (torch) checkpoints to this framework's format.
+
+The reference saves whole-model checkpoints as the ``state_dict`` of an
+``nn.ModuleList`` holding the decomposed layers
+(``scaelum/dynamics/parameter_server.py:29-33``): keys look like
+``"{layer_idx}.{submodule path}.weight"``.  This module maps those entries
+onto the flax parameter trees of the equivalent registered layers:
+
+- torch ``Linear.weight`` is [out, in] -> flax ``Dense.kernel`` [in, out]
+  (transposed);
+- torch ``Embedding.weight`` -> flax ``Embed.embedding`` (as-is);
+- torch ``LayerNorm.weight/bias`` -> flax ``scale``/``bias``;
+- submodule names follow the reference zoo (``attention.self.query`` ->
+  ``self.query`` etc. — the wrapping module name differs per layer type).
+
+Loading the pickle requires torch (CPU build is fine); everything after is
+numpy.  Conversion is layer-indexed, so the result is loadable under ANY
+allocation, like every checkpoint here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+
+def _linear(sd: Dict[str, np.ndarray], prefix: str) -> Dict[str, np.ndarray]:
+    out = {"kernel": np.ascontiguousarray(sd[f"{prefix}.weight"].T)}
+    if f"{prefix}.bias" in sd:
+        out["bias"] = sd[f"{prefix}.bias"]
+    return out
+
+
+def _layer_norm(sd: Dict[str, np.ndarray], prefix: str) -> Dict[str, np.ndarray]:
+    return {"scale": sd[f"{prefix}.weight"], "bias": sd[f"{prefix}.bias"]}
+
+
+def _embedding(sd: Dict[str, np.ndarray], prefix: str) -> Dict[str, np.ndarray]:
+    return {"embedding": sd[f"{prefix}.weight"]}
+
+
+def convert_layer(layer_type: str, sd: Dict[str, np.ndarray]) -> Any:
+    """One reference layer's state dict (keys already de-prefixed) ->
+    the flax params tree of the registered layer of the same name."""
+    if layer_type == "BertEmbeddings":
+        return {
+            "word_embeddings": _embedding(sd, "word_embeddings"),
+            "position_embeddings": _embedding(sd, "position_embeddings"),
+            "token_type_embeddings": _embedding(sd, "token_type_embeddings"),
+            "LayerNorm": _layer_norm(sd, "LayerNorm"),
+        }
+    if layer_type == "BertLayer_Head":
+        return {
+            "self": {
+                "query": _linear(sd, "attention.self.query"),
+                "key": _linear(sd, "attention.self.key"),
+                "value": _linear(sd, "attention.self.value"),
+            },
+            "output": {
+                "dense": _linear(sd, "attention.output.dense"),
+                "LayerNorm": _layer_norm(sd, "attention.output.LayerNorm"),
+            },
+        }
+    if layer_type == "BertLayer_Body":
+        return {"dense_act": _linear(sd, "intermediate.dense_act")}
+    if layer_type == "BertLayer_Tail":
+        return {
+            "dense": _linear(sd, "output.dense"),
+            "LayerNorm": _layer_norm(sd, "output.LayerNorm"),
+        }
+    if layer_type == "BertPooler":
+        return {"dense_act": _linear(sd, "dense_act")}
+    if layer_type == "BertTailForClassification":
+        return {"classifier": _linear(sd, "classifier")}
+    raise ValueError(f"no conversion rule for layer type {layer_type!r}")
+
+
+def split_modulelist_state_dict(
+    state: Dict[str, Any]
+) -> List[Dict[str, np.ndarray]]:
+    """"{idx}.{path}" keys -> per-layer dicts of numpy arrays, in order."""
+    layers: Dict[int, Dict[str, np.ndarray]] = {}
+    for key, value in state.items():
+        idx_str, path = key.split(".", 1)
+        arr = np.asarray(
+            value.detach().cpu().numpy() if hasattr(value, "detach") else value
+        )
+        layers.setdefault(int(idx_str), {})[path] = arr
+    return [layers[i] for i in sorted(layers)]
+
+
+def convert_torch_checkpoint(
+    checkpoint_path: str, model_cfg: List[Dict]
+) -> List[Any]:
+    """Reference ``.pth`` whole-model checkpoint -> layer-indexed params.
+
+    ``model_cfg`` is the layer-config list the checkpoint was trained
+    against (layer order defines the mapping).
+    """
+    import torch  # CPU build; only used to unpickle
+
+    state = torch.load(checkpoint_path, map_location="cpu",
+                       weights_only=True)
+    per_layer = split_modulelist_state_dict(state)
+    if len(per_layer) != len(model_cfg):
+        raise ValueError(
+            f"checkpoint has {len(per_layer)} layers, model config has "
+            f"{len(model_cfg)}"
+        )
+    return [
+        convert_layer(cfg["layer_type"], sd)
+        for cfg, sd in zip(model_cfg, per_layer)
+    ]
+
+
+__all__ = [
+    "convert_torch_checkpoint",
+    "convert_layer",
+    "split_modulelist_state_dict",
+]

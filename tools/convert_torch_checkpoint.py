@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""CLI: convert a reference (torch) whole-model checkpoint to msgpack.
+
+    python tools/convert_torch_checkpoint.py \
+        --checkpoint epoch_1.pth \
+        --preset large --layer-num 10 --num-classes 3 \
+        --out epoch_1.msgpack
+
+The layer-config list is reconstructed from the same knobs the reference
+experiment used (LAYER_NUM encoder trios, BERT preset); the output loads
+via ``ParameterServer.load_weights_from_file`` under any allocation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--checkpoint", required=True)
+    parser.add_argument("--out", required=True)
+    parser.add_argument("--preset", default="large")
+    parser.add_argument("--layer-num", type=int, default=10)
+    parser.add_argument("--num-classes", type=int, default=3)
+    args = parser.parse_args()
+
+    from flax import serialization
+
+    from skycomputing_tpu.models import bert_config, bert_layer_configs
+    from skycomputing_tpu.utils.torch_convert import convert_torch_checkpoint
+
+    model_cfg = bert_layer_configs(
+        bert_config(args.preset), num_encoder_units=args.layer_num,
+        num_classes=args.num_classes,
+    )
+    params = convert_torch_checkpoint(args.checkpoint, model_cfg)
+    with open(args.out, "wb") as fh:
+        fh.write(serialization.msgpack_serialize({"layers": params}))
+    print(f"converted {len(params)} layers -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
