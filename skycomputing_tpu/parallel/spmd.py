@@ -102,10 +102,21 @@ class CompiledBertPipeline:
         num_classes: int = 3,
         num_microbatches: Optional[int] = None,
         learning_rate: float = 1e-3,
+        virtual_stages: int = 1,
     ):
         self.cfg = BertConfig.from_dict(config)
         self.mesh = mesh
         self.num_stages = int(mesh.shape["pp"])
+        # interleaved scheduling (Megatron-style): each device owns
+        # ``virtual_stages`` model chunks placed round-robin.  At M == S the
+        # per-device bubble shrinks from (S-1)/(M+S-1) to (S-1)/(M+V*S-1)
+        # in chunk-time units; for M < S idle ticks are V*(S-M)+M-1.  The
+        # collision-free wavefront needs M <= S.
+        self.virtual_stages = int(virtual_stages)
+        if self.virtual_stages < 1:
+            raise ValueError(
+                f"virtual_stages must be >= 1, got {virtual_stages}"
+            )
         # optional data-parallel axis: batch sharded over 'dp', stage params
         # replicated across it.  Inside the shard_map the stage-grad
         # reduction over 'dp' comes from the spec-driven transpose (params'
@@ -115,6 +126,11 @@ class CompiledBertPipeline:
         self.units_per_stage = units_per_stage
         self.num_classes = num_classes
         self.num_microbatches = num_microbatches or self.num_stages
+        if self.virtual_stages > 1 and self.num_microbatches > self.num_stages:
+            raise ValueError(
+                f"interleaved scheduling needs num_microbatches "
+                f"({self.num_microbatches}) <= num_stages ({self.num_stages})"
+            )
         self.optimizer = optax.sgd(learning_rate)
 
         cfg_dict = self.cfg.to_dict()
@@ -148,8 +164,13 @@ class CompiledBertPipeline:
         def init_one_stage(key):
             return self.stage.init({"params": key}, hidden, mask4)["params"]
 
-        stage_keys = jax.random.split(k_stage, self.num_stages)
-        stages = jax.vmap(init_one_stage)(stage_keys)  # leading dim = S
+        S, V = self.num_stages, self.virtual_stages
+        chunk_keys = jax.random.split(k_stage, S * V)
+        # stacked position p on device p//V, local slot p%V, holds model
+        # chunk c = (p%V)*S + p//V — round-robin placement so sharding the
+        # leading axis over 'pp' gives each device chunks {d, S+d, 2S+d,...}
+        order = [(p % V) * S + p // V for p in range(S * V)]
+        stages = jax.vmap(init_one_stage)(chunk_keys[jnp.asarray(order)])
 
         pooler_vars = self.pooler.init({"params": k_pool}, hidden, mask4)
         pooled = self.pooler.apply(pooler_vars, hidden, mask4)
@@ -179,6 +200,26 @@ class CompiledBertPipeline:
         return self.optimizer.init(params)
 
     # --- the pipelined encoder ----------------------------------------------
+    def _run_ring_schedule(self, body, stage_params, hidden_mb, mask_mb):
+        """Shared shard_map scaffolding for both pipeline schedules.
+
+        ``body(local_stage_params, hidden_mb, mask_mb) -> [M, ...]`` runs
+        per device; activations keep their optional dp sharding, outputs
+        stack per-stage buffers along axis 0 and only the last device's
+        block (the final stage/chunk) is meaningful.
+        """
+        M = self.num_microbatches
+        act_spec = P(None, "dp") if self.dp > 1 else P()
+        out_spec = P("pp", "dp") if self.dp > 1 else P("pp")
+        out = jax.shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(self._stage_spec, act_spec, act_spec),
+            out_specs=out_spec,
+            check_vma=False,
+        )(stage_params, hidden_mb, mask_mb)
+        return out[-M:]
+
     def _pipelined_encoder(self, stage_params, hidden_mb, mask_mb):
         """shard_map GPipe: [M, mb, L, H] -> [M, mb, L, H]."""
         S = self.num_stages
@@ -219,20 +260,62 @@ class CompiledBertPipeline:
             )
             return outputs
 
-        # activations: microbatch axis 0 gathers per-stage buffers ('pp'),
-        # per-microbatch batch axis 1 stays sharded over 'dp' (if present)
-        act_spec = P(None, "dp") if self.dp > 1 else P()
-        out_spec = P("pp", "dp") if self.dp > 1 else P("pp")
-        out = jax.shard_map(
-            body,
-            mesh=self.mesh,
-            in_specs=(self._stage_spec, act_spec, act_spec),
-            out_specs=out_spec,
-            check_vma=False,
-        )(stage_params, hidden_mb, mask_mb)
-        # axis 0 concatenates per-stage [M, ...] buffers -> [S*M, ...]; only
-        # the last stage's block holds the completed microbatches
-        return out[-M:]
+        return self._run_ring_schedule(body, stage_params, hidden_mb, mask_mb)
+
+    def _interleaved_encoder(self, stage_params, hidden_mb, mask_mb):
+        """V>1 chunk-wavefront schedule: [M, mb, L, H] -> [M, mb, L, H].
+
+        Chunk c (device c mod S, local slot c // S) processes microbatch m
+        at tick t = m + c; with M <= S each device runs at most one chunk
+        per tick, and the uniform neighbor ring delivers every chunk
+        transition — including slot boundaries (chunk vS-1 on device S-1
+        feeds chunk vS on device 0).
+        """
+        S, V, M = self.num_stages, self.virtual_stages, self.num_microbatches
+        C = S * V
+        T = M + C - 1
+        stage_mod = self.stage
+
+        def body(local_stage_params, hidden_mb, mask_mb):
+            d = lax.axis_index("pp")
+            fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+
+            state = jnp.zeros_like(hidden_mb[0])
+            outputs = jnp.zeros_like(hidden_mb)
+
+            def tick(carry, t):
+                state, outputs = carry
+                recv = lax.ppermute(state, "pp", fwd_perm)
+                k = (t - d) // S  # jnp floor-division: negative -> k < 0
+                m = t - d - S * k
+                k_c = jnp.clip(k, 0, V - 1)
+                m_c = jnp.clip(m, 0, M - 1)
+
+                params_k = jax.tree_util.tree_map(
+                    lambda x: lax.dynamic_index_in_dim(
+                        x, k_c, 0, keepdims=False
+                    ),
+                    local_stage_params,
+                )
+                is_first_chunk = (d == 0) & (k_c == 0)
+                inp = jnp.where(is_first_chunk, hidden_mb[m_c], recv)
+                out, _ = stage_mod.apply(
+                    {"params": params_k}, inp, mask_mb[m_c]
+                )
+                # idle ticks (bubble) compute on clamped inputs; their
+                # outputs are never consumed by an active receiver
+                w = jnp.clip(t - (C - 1), 0, M - 1)
+                outputs = lax.dynamic_update_index_in_dim(
+                    outputs, out, w, axis=0
+                )
+                return (out, outputs), None
+
+            (_, outputs), _ = lax.scan(
+                tick, (state, outputs), jnp.arange(T)
+            )
+            return outputs
+
+        return self._run_ring_schedule(body, stage_params, hidden_mb, mask_mb)
 
     # --- full model ----------------------------------------------------------
     def _logits(self, params, input_ids, token_type_ids, attention_mask):
@@ -251,7 +334,14 @@ class CompiledBertPipeline:
         hidden_mb = hidden.reshape(M, B // M, *hidden.shape[1:])
         mask_mb = mask4.reshape(M, B // M, *mask4.shape[1:])
 
-        encoded = self._pipelined_encoder(params["stages"], hidden_mb, mask_mb)
+        if self.virtual_stages > 1:
+            encoded = self._interleaved_encoder(
+                params["stages"], hidden_mb, mask_mb
+            )
+        else:
+            encoded = self._pipelined_encoder(
+                params["stages"], hidden_mb, mask_mb
+            )
         encoded = encoded.reshape(B, *encoded.shape[2:])
 
         pooled = self.pooler.apply(
