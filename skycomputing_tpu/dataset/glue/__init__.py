@@ -15,6 +15,7 @@ from .tokenization import (
     WordpieceTokenizer,
     build_synthetic_vocab,
     load_vocab,
+    train_wordpiece_vocab,
 )
 
 __all__ = [
@@ -32,4 +33,5 @@ __all__ = [
     "WordpieceTokenizer",
     "build_synthetic_vocab",
     "load_vocab",
+    "train_wordpiece_vocab",
 ]

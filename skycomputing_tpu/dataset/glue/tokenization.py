@@ -212,6 +212,96 @@ class BertTokenizer:
 SPECIAL_TOKENS = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
 
 
+def train_wordpiece_vocab(
+    texts,
+    vocab_size: int = 8000,
+    min_frequency: int = 2,
+    do_lower_case: bool = True,
+    num_merges_per_round: int = 200,
+) -> Dict[str, int]:
+    """Learn a WordPiece vocabulary from raw texts (BPE-style training).
+
+    The reference only ships a *loader* for pretrained vocab files; this
+    trainer closes the loop for from-scratch corpora.  Standard algorithm:
+    words become character sequences (continuations prefixed ``##``), then
+    the highest-frequency adjacent pair is merged repeatedly until the
+    vocabulary budget is spent.  Greedy longest-match tokenization with the
+    result reconstructs training words exactly.
+
+    ``vocab_size`` caps the TOTAL vocabulary (special tokens + base
+    characters + merged subwords).  The specials and the corpus's
+    base-character inventory are always included even when they alone
+    exceed the budget — dropping them would make training words
+    untokenizable — so tiny budgets are overshot, and large budgets spend
+    ``vocab_size - specials - characters`` entries on merges.
+    """
+    basic = BasicTokenizer(do_lower_case=do_lower_case)
+    word_freq: Dict[str, int] = collections.Counter()
+    for text in texts:
+        for word in basic.tokenize(text):
+            word_freq[word] += 1
+
+    # each word as a tuple of current symbols
+    words = {
+        w: [w[0]] + ["##" + ch for ch in w[1:]]
+        for w, f in word_freq.items()
+        if f >= min_frequency
+    }
+
+    vocab = collections.OrderedDict(
+        (t, i) for i, t in enumerate(SPECIAL_TOKENS)
+    )
+
+    def add(token: str) -> None:
+        if token not in vocab:
+            vocab[token] = len(vocab)
+
+    for symbols in words.values():
+        for s in symbols:
+            add(s)
+
+    while len(vocab) < vocab_size:
+        pair_freq: Dict[tuple, int] = collections.Counter()
+        for w, symbols in words.items():
+            f = word_freq[w]
+            for a, b in zip(symbols, symbols[1:]):
+                pair_freq[(a, b)] += f
+        if not pair_freq:
+            break
+        # merge a batch of top pairs per round, applied in ONE pass per
+        # word (left-to-right, higher-frequency pair wins on overlap):
+        # one-pair-per-corpus-scan training is O(vocab * corpus), and so
+        # is scanning once per batched pair — batching trades exact
+        # merge order for a num_merges_per_round speedup
+        merges: Dict[tuple, str] = {}
+        for (a, b), f in pair_freq.most_common(num_merges_per_round):
+            if len(vocab) + len(merges) >= vocab_size or f < min_frequency:
+                break
+            merged = a + b.removeprefix("##")
+            if merged in vocab or merged in merges.values():
+                continue
+            merges[(a, b)] = merged
+        if not merges:
+            break
+        for merged in merges.values():
+            add(merged)
+        for w, symbols in words.items():
+            out = []
+            i = 0
+            while i < len(symbols):
+                if (
+                    i + 1 < len(symbols)
+                    and (symbols[i], symbols[i + 1]) in merges
+                ):
+                    out.append(merges[(symbols[i], symbols[i + 1])])
+                    i += 2
+                else:
+                    out.append(symbols[i])
+                    i += 1
+            words[w] = out
+    return vocab
+
+
 def build_synthetic_vocab(size: int = 1024, seed: int = 0) -> Dict[str, int]:
     """Deterministic toy vocabulary for offline/zero-download operation."""
     import random
@@ -236,5 +326,6 @@ __all__ = [
     "WordpieceTokenizer",
     "BertTokenizer",
     "build_synthetic_vocab",
+    "train_wordpiece_vocab",
     "SPECIAL_TOKENS",
 ]

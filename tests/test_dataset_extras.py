@@ -46,6 +46,31 @@ def test_synthetic_vocab_deterministic():
     assert build_synthetic_vocab(256) == build_synthetic_vocab(256)
 
 
+def test_train_wordpiece_vocab_roundtrip():
+    from skycomputing_tpu.dataset.glue.tokenization import (
+        train_wordpiece_vocab,
+    )
+
+    corpus = [
+        "the quick brown fox jumps over the lazy dog",
+        "the quick brown cat sleeps under the lazy tree",
+        "quick foxes and quick cats are quick",
+    ] * 5
+    vocab = train_wordpiece_vocab(corpus, vocab_size=200, min_frequency=2)
+    assert "[UNK]" in vocab and "[CLS]" in vocab
+    tok = BertTokenizer(vocab=vocab, do_lower_case=True)
+    # frequent training words tokenize without [UNK] and reconstruct
+    pieces = tok.tokenize("the quick brown fox")
+    assert "[UNK]" not in pieces
+    rebuilt = "".join(p.removeprefix("##") if p.startswith("##") else " " + p
+                      for p in pieces).strip()
+    assert rebuilt == "the quick brown fox"
+    # very frequent words should have merged into single tokens
+    assert "quick" in vocab
+    # unseen characters fall back to [UNK], not a crash
+    assert tok.tokenize("Ω") == ["[UNK]"]
+
+
 def test_runner_evaluate(devices):
     import jax
 

@@ -1,0 +1,48 @@
+"""Guards for the driver entry points and the config ladder."""
+
+import os.path as osp
+
+import jax
+import pytest
+
+
+def test_graft_entry_shapes():
+    """entry() must return a traceable fn + example args (shape-level check
+    — the driver does the real single-chip compile)."""
+    import __graft_entry__ as graft
+
+    fn, args = graft.entry()
+    out = jax.eval_shape(fn, *args)
+    assert out.shape == (4, 3)
+
+
+@pytest.mark.parametrize(
+    "name,alloc,workers",
+    [
+        ("even_4.py", "even", 4),
+        ("optimal_8.py", "optimal", 8),
+        ("dynamic_8_stim.py", "dynamic", 8),
+        ("optimal_32_96layer.py", "optimal", 32),
+        ("optimal_64_160layer.py", "optimal", 64),
+    ],
+)
+def test_ladder_configs_load(monkeypatch, name, alloc, workers):
+    monkeypatch.setenv("SKYTPU_PRESET", "tiny")  # keep model assembly light
+    from skycomputing_tpu import load_config
+
+    # ladder configs set SKYTPU_*/STIMULATE in os.environ themselves;
+    # snapshot and restore so nothing leaks into later tests
+    import os
+
+    saved = dict(os.environ)
+    try:
+        cfg = load_config(
+            osp.join(osp.dirname(osp.dirname(osp.abspath(__file__))),
+                     "experiment", "configs", name)
+        )
+    finally:
+        os.environ.clear()
+        os.environ.update(saved)
+    assert cfg.allocator_config["type"] == alloc
+    assert len(cfg.worker_config) == workers
+    assert len(cfg.model_config) > 0
