@@ -178,15 +178,29 @@ class Runner:
                 break
 
     # --- evaluation ----------------------------------------------------------
-    def evaluate(self, data_loader, max_batches: Optional[int] = None) -> Dict:
+    def evaluate(
+        self,
+        data_loader,
+        max_batches: Optional[int] = None,
+        task: Optional[str] = None,
+    ) -> Dict:
         """Eval pass: mean loss + accuracy over a dataloader.
 
         Runs the pipeline forward in eval mode (no dropout rngs) with the
-        ``val`` hook lifecycle.  The reference has no eval loop at all —
-        its runner only trains — so this is capability the decomposed model
-        zoo makes free.
+        ``val`` hook lifecycle.  ``task`` adds the GLUE task's own metrics
+        (F1 for mrpc, Matthews for cola, ...) computed over all predictions.
+        The reference has no eval loop at all — its runner only trains —
+        so this is capability the decomposed model zoo makes free.
         """
         import numpy as np
+
+        if task is not None:
+            from ..ops.metrics import TASK_METRICS
+
+            if task.lower() not in TASK_METRICS:
+                raise ValueError(
+                    f"unknown task {task!r}; known: {sorted(TASK_METRICS)}"
+                )
 
         self.model.train(False)
         self._call_hook("before_val_epoch")
@@ -194,6 +208,8 @@ class Runner:
         correct = 0
         num_predictions = 0
         num_examples = 0
+        all_preds = [] if task is not None else None
+        all_labels = [] if task is not None else None
         for i, (data, labels) in enumerate(data_loader):
             if max_batches is not None and i >= max_batches:
                 break
@@ -209,6 +225,12 @@ class Runner:
             loss_sum += batch_loss * n
             logits_host = np.asarray(logits)
             if logits_host.ndim == 3:
+                if task is not None:
+                    raise ValueError(
+                        "task metrics need per-example classification "
+                        "logits; got token-level logits "
+                        f"{logits_host.shape}"
+                    )
                 # token-level (causal LM): the logit at position t predicts
                 # token t+1, so compare shifted
                 preds = logits_host.argmax(axis=-1)[:, :-1]
@@ -216,19 +238,34 @@ class Runner:
                 correct += int((preds == targets).sum())
                 num_predictions += targets.size
             else:
-                correct += int((logits_host.argmax(axis=-1) == labels).sum())
+                preds = logits_host.argmax(axis=-1)
+                correct += int((preds == labels).sum())
                 num_predictions += n
+                if all_preds is not None:
+                    all_preds.append(preds)
+                    all_labels.append(labels)
             num_examples += n
             self._call_hook("after_val_iter")
         self._call_hook("after_val_epoch")
         self.model.train(True)
-        return {
+        result = {
             "loss": loss_sum / num_examples if num_examples else float("nan"),
             "accuracy": (
                 correct / num_predictions if num_predictions else float("nan")
             ),
             "num_examples": num_examples,
         }
+        if all_preds:
+            from ..ops.metrics import compute_task_metrics
+
+            task_metrics = compute_task_metrics(
+                task, np.concatenate(all_preds), np.concatenate(all_labels)
+            )
+            # accuracy is already computed incrementally above
+            result.update(
+                {k: v for k, v in task_metrics.items() if k not in result}
+            )
+        return result
 
 
 __all__ = ["Runner"]

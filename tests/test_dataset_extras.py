@@ -116,3 +116,7 @@ def test_runner_evaluate(devices):
     assert 0.0 <= metrics["accuracy"] <= 1.0
     assert np.isfinite(metrics["loss"])
     assert metrics["num_examples"] == 32
+
+    # task-aware metrics: mnli adds nothing beyond accuracy, mrpc adds f1
+    m2 = runner.evaluate(Adapter(), task="mrpc")
+    assert "f1" in m2 and "accuracy" in m2

@@ -5,6 +5,7 @@ import os.path as osp
 import jax
 import numpy as np
 import optax
+import pytest
 
 from skycomputing_tpu.builder import build_hook
 from skycomputing_tpu.dataset import DataLoader, RandomBertDataset
@@ -202,6 +203,47 @@ def test_eval_mode_forward_is_deterministic(devices):
 def test_build_hook_from_registry(tmp_path):
     hook = build_hook(dict(type="StopHook", root=str(tmp_path)))
     assert isinstance(hook, StopHook)
+
+
+def test_evaluate_ragged_batches_weight_per_example(devices):
+    """drop_last=False: the short final batch must not skew the mean loss."""
+    model, ps, wm, loader = build_world(devices)
+
+    class Ragged:
+        """20 examples as batches of 8, 8, 4 — identical rows throughout."""
+
+        def __iter__(self):
+            (ids, mask, segs), labels = next(iter(_BatchAdapter(loader)))
+            for n in (8, 8, 4):
+                yield (ids[:n], mask[:n], segs[:n]), labels[:n]
+
+        def __len__(self):
+            return 3
+
+    runner = Runner(model, ps, wm, max_epochs=0, max_iters=0)
+    metrics = runner.evaluate(Ragged())
+    assert metrics["num_examples"] == 20
+    # all rows identical -> per-example mean equals any batch's mean; if the
+    # ragged batch were weighted per-batch instead, this would still hold,
+    # so also check via two differing batches:
+    batch_iter = iter(_BatchAdapter(loader))
+    (ids, mask, segs), labels = next(batch_iter)
+
+    class TwoBatches:
+        def __iter__(self):
+            yield (ids, mask, segs), labels          # 8 examples
+            yield (ids[:2], mask[:2], segs[:2]), labels[:2]  # 2 examples
+
+        def __len__(self):
+            return 2
+
+    m = runner.evaluate(TwoBatches())
+    big = float(model._loss_fn(model.forward((ids, mask, segs)),
+                               jax.numpy.asarray(labels)))
+    small = float(model._loss_fn(model.forward((ids[:2], mask[:2], segs[:2])),
+                                 jax.numpy.asarray(labels[:2])))
+    expected = (big * 8 + small * 2) / 10
+    assert m["loss"] == pytest.approx(expected, rel=1e-5)
 
 
 def test_eval_and_metrics_hooks(devices, tmp_path):

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Operator tool: profile a config's model + devices and preview allocations.
+
+    python tools/profile_allocation.py -c experiment/config.py
+
+Prints the per-layer FLOPs/memory profile, the per-worker device profile
+(with stimulator distortion if STIMULATE is set), and the partition each
+strategy would choose — without building the pipeline or training.  The
+allocation question ("where would my layers go, and why") becomes
+answerable in seconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("-c", "--config", required=True)
+    parser.add_argument(
+        "--strategies", default="even,dynamic,optimal",
+        help="comma-separated subset of even,dynamic,optimal",
+    )
+    args = parser.parse_args()
+
+    import jax
+
+    from skycomputing_tpu import load_config
+    from skycomputing_tpu.builder import build_data_generator
+    from skycomputing_tpu.dynamics import (
+        Allocator,
+        DeviceBenchmarker,
+        ModelBenchmarker,
+        WorkerManager,
+    )
+    from skycomputing_tpu.stimulator import Stimulator
+
+    cfg = load_config(args.config)
+    devices = jax.devices()
+    wm = WorkerManager()
+    wm.load_worker_pool_from_config(cfg.worker_config)
+
+    bench_cfg = cfg.allocator_config["benchmark_config"]
+    model_bench = ModelBenchmarker(
+        cfg.model_config,
+        build_data_generator(**bench_cfg["model"]["data_generator_cfg"]),
+    )
+    stim = (
+        Stimulator(wm.size) if os.getenv("STIMULATE") is not None else None
+    )
+    device_bench = DeviceBenchmarker(
+        wm,
+        build_data_generator(**bench_cfg["device"]["data_generator_cfg"]),
+        bench_cfg["device"]["model_config"],
+        iterations=bench_cfg["device"].get("iterations", 5),
+        devices=devices,
+        stimulator=stim,
+    )
+
+    print(f"== model profile ({len(cfg.model_config)} layers) ==")
+    flops, mem = model_bench.benchmark()
+    shown = set()
+    for i, layer_cfg in enumerate(cfg.model_config):
+        key = layer_cfg["layer_type"]
+        tag = ""
+        if key in shown:
+            continue  # one row per layer type; repeats profile identically
+        shown.add(key)
+        count = sum(
+            1 for c in cfg.model_config if c["layer_type"] == key
+        )
+        tag = f" x{count}" if count > 1 else ""
+        print(f"  [{i:3d}] {key:28s}{tag:6s} "
+              f"{flops[i]:.3e} flops  {mem[i]:8.1f} MB")
+    print(f"  total: {sum(flops):.3e} flops, {sum(mem):.1f} MB")
+
+    print(f"\n== device profile ({wm.size} workers"
+          f"{', stimulated' if stim else ''}) ==")
+    profile = device_bench.benchmark()
+    for name, p in profile.items():
+        print(f"  {name:10s} time={p['time']:.4f}s  "
+              f"avai_mem={p['avai_mem']:.0f} MB")
+
+    for strategy in args.strategies.split(","):
+        strategy = strategy.strip()
+        wm2 = WorkerManager()
+        wm2.load_worker_pool_from_config(cfg.worker_config)
+        allocator = Allocator(cfg.model_config, wm2, model_bench,
+                              device_bench)
+        try:
+            getattr(allocator, f"{strategy}_allocate")()
+        except AttributeError:
+            print(f"\n== {strategy}: unknown strategy ==")
+            continue
+        except Exception as exc:
+            print(f"\n== {strategy}: allocation failed: {exc} ==")
+            continue
+        print(f"\n== {strategy} partition ==")
+        for w in sorted(wm2.worker_pool, key=lambda w: w.rank):
+            n = len(w.model_config or [])
+            bar = "#" * n
+            print(f"  stage {w.rank:3d} ({w.name:10s}) {n:4d} layers {bar}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
