@@ -45,14 +45,14 @@ from ..dynamics.parameter_server import ParameterServer
 from ..dynamics.worker_manager import WorkerManager
 
 
-def _split_microbatches(tree, num_microbatches: int):
-    """Leading-axis split of every leaf into M equal microbatches."""
+def _split_microbatches(tree, num_microbatches: int, what: str = "microbatches"):
+    """Leading-axis split of every leaf into equal shards."""
     def split(x):
         x = np.asarray(x)
         if x.shape[0] % num_microbatches != 0:
             raise ValueError(
                 f"batch size {x.shape[0]} not divisible by "
-                f"num_microbatches={num_microbatches}"
+                f"{num_microbatches} {what}"
             )
         return x.reshape(num_microbatches, x.shape[0] // num_microbatches,
                          *x.shape[1:])
@@ -391,6 +391,37 @@ class PipelineModel:
         """
         if self.schedule == "1f1b" and self.num_microbatches > 1:
             return self._train_step_1f1b(data, labels, rng)
+        grad_totals, losses, (t0, t1, t2) = self.compute_gradients(
+            data, labels, rng
+        )
+        self.apply_gradients(grad_totals)
+        jax.block_until_ready(self.stages[0].params)
+        t3 = time.perf_counter()
+
+        total_loss = float(sum(jax.device_get(l) for l in losses))
+        self.stats = PipelineStats(
+            forward_s=t1 - t0, backward_s=t2 - t1, step_s=t3 - t2,
+            loss=total_loss,
+        )
+        return total_loss
+
+    def compute_gradients(
+        self,
+        data,
+        labels,
+        rng: Optional[jax.Array] = None,
+        block: bool = True,
+    ):
+        """GPipe fwd/bwd without the update: (per-stage grad totals,
+        per-microbatch scaled losses, phase timestamps).
+
+        The split from ``apply_gradients`` is what data-parallel replication
+        builds on: replicas compute grads independently, average, then each
+        applies the same averaged update.  ``block=False`` skips the
+        per-phase ``block_until_ready`` barriers so a caller can dispatch
+        several replicas' work before any of it completes (the timestamps
+        then measure dispatch, not compute).
+        """
         if rng is None:
             rng = jax.random.key(int(time.time_ns() % (2**31)))
         M = self.num_microbatches
@@ -417,7 +448,8 @@ class PipelineModel:
                 stage_inputs[k].append(acts)
                 acts = stage.forward(acts, rngs[m][k])
             final_acts_per_mb.append(acts)
-        jax.block_until_ready(final_acts_per_mb[-1])
+        if block:
+            jax.block_until_ready(final_acts_per_mb[-1])
         t1 = time.perf_counter()
 
         # ---- loss + backward (drain), accumulating grads per stage
@@ -438,21 +470,15 @@ class PipelineModel:
                 grads, dx = stage.backward(stage_inputs[k][m], rngs[m][k], dy)
                 grad_totals[k] = stage.accumulate(grad_totals[k], grads)
                 dy = dx
-        jax.block_until_ready(grad_totals[0])
+        if block:
+            jax.block_until_ready(grad_totals[0])
         t2 = time.perf_counter()
+        return grad_totals, losses, (t0, t1, t2)
 
-        # ---- apply updates per stage
+    def apply_gradients(self, grad_totals) -> None:
+        """Apply per-stage gradient totals with each stage's optimizer."""
         for k, stage in enumerate(self.stages):
             stage.apply_gradients(grad_totals[k])
-        jax.block_until_ready(self.stages[0].params)
-        t3 = time.perf_counter()
-
-        total_loss = float(sum(jax.device_get(l) for l in losses))
-        self.stats = PipelineStats(
-            forward_s=t1 - t0, backward_s=t2 - t1, step_s=t3 - t2,
-            loss=total_loss,
-        )
-        return total_loss
 
     def _train_step_1f1b(self, data, labels, rng) -> float:
         """One-forward-one-backward schedule: issue each microbatch's

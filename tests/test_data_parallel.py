@@ -1,0 +1,71 @@
+"""Data-parallel MPMD replicas: grads == full batch, replicas stay in sync."""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from skycomputing_tpu.dynamics import Allocator, ParameterServer, WorkerManager
+from skycomputing_tpu.models import bert_config, bert_layer_configs
+from skycomputing_tpu.ops import cross_entropy_loss
+from skycomputing_tpu.parallel import DataParallelPipeline, PipelineModel
+
+
+def build(devices, n_workers=4, n_replicas=2, seed=0):
+    cfg = bert_config("tiny", dtype="float32", hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)
+    model_cfg = bert_layer_configs(cfg, num_encoder_units=2, num_classes=3,
+                                   deterministic=True)
+    wm = WorkerManager()
+    wm.load_worker_pool_from_config(
+        [dict(name=f"n{i}", device_config=dict(device_index=i),
+              extra_config={}) for i in range(n_workers)]
+    )
+    Allocator(model_cfg, wm, None, None).even_allocate()
+
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(5, 1024, size=(8, 16)).astype(np.int32)
+    data = (ids, np.zeros_like(ids), np.ones_like(ids))
+    labels = rng.integers(0, 3, size=(8,)).astype(np.int32)
+    ps = ParameterServer(model_cfg, example_inputs=data,
+                         rng=jax.random.key(seed))
+    return wm, ps, data, labels
+
+
+def test_dp_update_equals_full_batch(devices):
+    """R=2 averaged-grad update == single pipeline on the full batch
+    (deterministic model, loss is a per-example mean)."""
+    wm, ps, data, labels = build(devices)
+    dp = DataParallelPipeline(wm, ps, optax.sgd(1e-2), cross_entropy_loss,
+                              num_replicas=2, devices=devices)
+    single = PipelineModel(wm, ps, optax.sgd(1e-2), cross_entropy_loss,
+                           devices=devices[:4])
+    loss_dp = dp.train_step(data, labels, rng=jax.random.key(0))
+    loss_single = single.train_step(data, labels, rng=jax.random.key(0))
+    assert loss_dp == pytest.approx(loss_single, rel=1e-5)
+    for s_dp, s_one in zip(dp.replicas[0].stages, single.stages):
+        for a, b in zip(jax.tree_util.tree_leaves(s_dp.params),
+                        jax.tree_util.tree_leaves(s_one.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-7)
+
+
+def test_replicas_stay_identical_over_steps(devices):
+    wm, ps, data, labels = build(devices, seed=1)
+    dp = DataParallelPipeline(wm, ps, optax.adam(1e-3), cross_entropy_loss,
+                              num_replicas=2, devices=devices)
+    losses = [dp.train_step(data, labels, rng=jax.random.key(i))
+              for i in range(4)]
+    assert losses[-1] < losses[0]
+    for s0, s1 in zip(dp.replicas[0].stages, dp.replicas[1].stages):
+        assert s0.device != s1.device  # disjoint device groups
+        for a, b in zip(jax.tree_util.tree_leaves(s0.params),
+                        jax.tree_util.tree_leaves(s1.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_too_few_devices_rejected(devices):
+    wm, ps, *_ = build(devices)
+    with pytest.raises(ValueError, match="need 12 devices"):
+        DataParallelPipeline(wm, ps, optax.sgd(1e-2), cross_entropy_loss,
+                             num_replicas=3, devices=devices)
