@@ -8,6 +8,7 @@ from .data_generator import (
 )
 from .dataloader import DataLoader
 from .datasets import (
+    CIFAR10Dataset,
     RandomBertDataset,
     RandomImageDataset,
     RandomLmDataset,
@@ -23,6 +24,7 @@ __all__ = [
     "RandomTensorGenerator",
     "RandomTokenGenerator",
     "DataLoader",
+    "CIFAR10Dataset",
     "RandomBertDataset",
     "RandomImageDataset",
     "RandomLmDataset",

@@ -77,6 +77,58 @@ class RandomBertDataset:
 
 
 @DATASET.register_module
+class CIFAR10Dataset:
+    """CIFAR-10 from the local binary distribution (reference registry name,
+    ``scaelum/dataset/dataset.py:28``).
+
+    Reads the standard ``data_batch_*.bin`` files (3073-byte records: 1 label
+    byte + 3072 CHW pixel bytes) with pure numpy — no torchvision, no
+    downloads.  Missing ``data_dir`` degrades to a deterministic synthetic
+    set with identical row shapes, like ``GlueDataset``.
+    """
+
+    def __init__(self, data_dir: str = "", train: bool = True,
+                 num_synthetic: int = 256, seed: int = 0):
+        import glob
+        import os
+
+        pattern = "data_batch_*.bin" if train else "test_batch.bin"
+        files = sorted(glob.glob(os.path.join(data_dir, pattern))) if data_dir else []
+        if files:
+            records = np.concatenate([
+                np.frombuffer(open(f, "rb").read(), dtype=np.uint8).reshape(
+                    -1, 3073
+                )
+                for f in files
+            ])
+            self.labels = records[:, 0].astype(np.int64)
+            images = records[:, 1:].reshape(-1, 3, 32, 32)
+            self.images = images.astype(np.float32) / 255.0
+            self.synthetic = False
+        else:
+            if data_dir:
+                from ..utils import Logger
+
+                Logger().info(
+                    f"CIFAR10Dataset: no {pattern} under {data_dir!r} — "
+                    "using deterministic synthetic images (the binary "
+                    "distribution unpacks into cifar-10-batches-bin/)"
+                )
+            rng = np.random.default_rng(seed)
+            self.images = rng.random((num_synthetic, 3, 32, 32)).astype(
+                np.float32
+            )
+            self.labels = rng.integers(0, 10, size=(num_synthetic,))
+            self.synthetic = True
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        return (self.images[idx],), int(self.labels[idx])
+
+
+@DATASET.register_module
 class RandomLmDataset:
     """Synthetic causal-LM rows: ((input_ids,), input_ids).
 
@@ -107,4 +159,5 @@ __all__ = [
     "RandomImageDataset",
     "RandomBertDataset",
     "RandomLmDataset",
+    "CIFAR10Dataset",
 ]
