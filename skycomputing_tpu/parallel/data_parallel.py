@@ -152,6 +152,17 @@ class DataParallelPipeline:
         for model in self.replicas:
             model.train(mode)
 
+    # --- training state ------------------------------------------------------
+    def get_optimizer_state(self):
+        """Replica 0's state — replicas are bit-identical by construction."""
+        return self.replicas[0].get_optimizer_state()
+
+    def load_optimizer_state(self, state) -> None:
+        # restore into EVERY replica, preserving the identical-replicas
+        # invariant (restoring one would silently desync momentum)
+        for model in self.replicas:
+            model.load_optimizer_state(state)
+
     @property
     def _loss_fn(self):
         return self.replicas[0]._loss_fn

@@ -654,6 +654,48 @@ class PipelineModel:
             acts = jax.tree_util.tree_map(np.asarray, out)
         return times
 
+    # --- training state (optimizer) -----------------------------------------
+    def partition_signature(self) -> List[int]:
+        """Layer counts per stage — identifies the current allocation."""
+        return [stage.num_layers for stage in self.stages]
+
+    def get_optimizer_state(self) -> Dict:
+        """Host copy of every stage's optimizer state, tagged with the
+        partition it belongs to.
+
+        Unlike parameters (layer-indexed, partition-independent), optimizer
+        state pytrees are shaped per-stage, so restoring requires the SAME
+        allocation; the signature makes a mismatch detectable instead of
+        silently corrupting momentum.
+        """
+        from flax import serialization
+
+        return {
+            "partition": self.partition_signature(),
+            "stages": [
+                serialization.to_state_dict(
+                    jax.tree_util.tree_map(np.array, stage.opt_state)
+                )
+                for stage in self.stages
+            ],
+        }
+
+    def load_optimizer_state(self, state: Dict) -> None:
+        from flax import serialization
+
+        saved = list(state["partition"])
+        if saved != self.partition_signature():
+            raise ValueError(
+                f"optimizer state was saved under partition {saved}, "
+                f"current partition is {self.partition_signature()}; "
+                "re-allocate to match or restore parameters only"
+            )
+        for stage, stage_state in zip(self.stages, state["stages"]):
+            restored = serialization.from_state_dict(
+                stage.opt_state, stage_state
+            )
+            stage.opt_state = jax.device_put(restored, stage.device)
+
     # --- weights ------------------------------------------------------------
     def sync_to_parameter_server(self) -> None:
         """Gather every stage's layer params back into the host copy."""

@@ -16,6 +16,8 @@ import os
 import os.path as osp
 from typing import Optional
 
+import numpy as np
+
 from ...registry import HOOKS
 from ..hooks import Hook
 
@@ -28,6 +30,7 @@ class CheckpointHook(Hook):
         save_path: Optional[str] = None,
         save_interval: Optional[int] = None,
         format: str = "msgpack",  # msgpack (single file) | orbax (directory)
+        save_training_state: bool = False,
     ):
         if format not in ("msgpack", "orbax"):
             raise ValueError(f"unknown checkpoint format {format!r}")
@@ -35,6 +38,15 @@ class CheckpointHook(Hook):
         self._save_path = save_path
         self._save_interval = save_interval
         self._format = format
+        # also checkpoint optimizer state + epoch/iter counters for exact
+        # resume (params alone restart momentum and the schedule position).
+        # Training state is partition-DEPENDENT; restore requires the same
+        # allocation, while the params file stays partition-independent.
+        self._save_training_state = save_training_state
+
+    @staticmethod
+    def _training_state_path(params_path: str) -> str:
+        return params_path + ".train_state.msgpack"
 
     def before_run(self, runner):
         if self._load_checkpoint_from:
@@ -45,6 +57,32 @@ class CheckpointHook(Hook):
                 runner.parameter_server.load_weights_from_file(src)
             runner.model.load_from_parameter_server()
             runner.logger.info(f"restored checkpoint from {src}")
+
+            ts_path = self._training_state_path(src)
+            if os.path.exists(ts_path):
+                from flax import serialization
+
+                with open(ts_path, "rb") as fh:
+                    state = serialization.msgpack_restore(fh.read())
+                try:
+                    runner.model.load_optimizer_state(state["optimizer"])
+                except ValueError as exc:
+                    # re-allocation between save and resume: params are
+                    # partition-independent and already restored; losing
+                    # momentum is the documented cost — keep training
+                    runner.logger.info(
+                        f"training state not restored ({exc}); continuing "
+                        "with parameters only"
+                    )
+                    return
+                runner.epoch = int(state["epoch"])
+                runner.iter = int(state["iter"])
+                if "rng" in state:
+                    runner.restore_rng(np.asarray(state["rng"]))
+                runner.logger.info(
+                    f"restored training state (epoch={runner.epoch}, "
+                    f"iter={runner.iter}) from {ts_path}"
+                )
 
     def after_epoch(self, runner):
         if not self._save_path or not self._save_interval:
@@ -62,6 +100,22 @@ class CheckpointHook(Hook):
             path = osp.join(self._save_path, f"epoch_{runner.epoch}.msgpack")
             runner.parameter_server.save_weights_to_file(path)
         runner.logger.info(f"saved checkpoint to {path}")
+
+        if self._save_training_state:
+            from flax import serialization
+
+            state = {
+                "optimizer": runner.model.get_optimizer_state(),
+                "epoch": runner.epoch,
+                "iter": runner.iter,
+                # the step-rng split chain must also resume mid-stream, or
+                # a restored run replays epoch 1's dropout masks
+                "rng": runner.snapshot_rng(),
+            }
+            ts_path = self._training_state_path(path)
+            with open(ts_path, "wb") as fh:
+                fh.write(serialization.msgpack_serialize(state))
+            runner.logger.info(f"saved training state to {ts_path}")
 
 
 __all__ = ["CheckpointHook"]

@@ -109,6 +109,16 @@ class Runner:
         """Cooperative stop: finishes the current iteration then exits."""
         self._stop = True
 
+    # --- rng stream (checkpointable) ----------------------------------------
+    def snapshot_rng(self):
+        """Raw key data of the step-rng split chain, for checkpointing."""
+        import numpy as np
+
+        return np.asarray(jax.random.key_data(self._rng))
+
+    def restore_rng(self, key_data) -> None:
+        self._rng = jax.random.wrap_key_data(jax.numpy.asarray(key_data))
+
     # --- hooks --------------------------------------------------------------
     def register_hook(self, hook: Hook) -> None:
         assert isinstance(hook, Hook)
