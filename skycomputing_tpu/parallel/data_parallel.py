@@ -148,6 +148,10 @@ class DataParallelPipeline:
     def sync_to_parameter_server(self) -> None:
         self.replicas[0].sync_to_parameter_server()
 
+    def load_from_parameter_server(self) -> None:
+        for model in self.replicas:
+            model.load_from_parameter_server()
+
     def train(self, mode: bool = True) -> None:
         for model in self.replicas:
             model.train(mode)

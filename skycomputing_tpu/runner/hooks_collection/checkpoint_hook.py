@@ -64,21 +64,24 @@ class CheckpointHook(Hook):
 
                 with open(ts_path, "rb") as fh:
                     state = serialization.msgpack_restore(fh.read())
-                try:
-                    runner.model.load_optimizer_state(state["optimizer"])
-                except ValueError as exc:
-                    # re-allocation between save and resume: params are
-                    # partition-independent and already restored; losing
-                    # momentum is the documented cost — keep training
-                    runner.logger.info(
-                        f"training state not restored ({exc}); continuing "
-                        "with parameters only"
-                    )
-                    return
+                # counters and the rng stream are partition-independent —
+                # restore them regardless of whether the optimizer state
+                # (partition-tagged) can follow
                 runner.epoch = int(state["epoch"])
                 runner.iter = int(state["iter"])
                 if "rng" in state:
                     runner.restore_rng(np.asarray(state["rng"]))
+                try:
+                    runner.model.load_optimizer_state(state["optimizer"])
+                except ValueError as exc:
+                    # re-allocation between save and resume: losing
+                    # momentum is the documented cost — keep training
+                    runner.logger.info(
+                        f"optimizer state not restored ({exc}); resuming "
+                        f"at epoch={runner.epoch}, iter={runner.iter} with "
+                        "fresh optimizer state"
+                    )
+                    return
                 runner.logger.info(
                     f"restored training state (epoch={runner.epoch}, "
                     f"iter={runner.iter}) from {ts_path}"
