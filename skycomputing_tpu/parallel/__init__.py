@@ -2,6 +2,7 @@ from .data_parallel import DataParallelPipeline
 from .mesh import make_dp_pp_mesh, make_pipeline_mesh
 from .multihost import global_mesh, initialize_from_env, is_coordinator
 from .ring_attention import full_attention_reference, ring_attention
+from .ulysses import ulysses_attention
 from .pipeline import (
     PipelineModel,
     PipelineStats,
@@ -22,4 +23,5 @@ __all__ = [
     "is_coordinator",
     "ring_attention",
     "full_attention_reference",
+    "ulysses_attention",
 ]

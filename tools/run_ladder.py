@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Run the experiment config ladder and summarize phase timings.
+
+    python tools/run_ladder.py                   # all five configs
+    python tools/run_ladder.py --only even_4 optimal_8
+    SKYTPU_PRESET=tiny python tools/run_ladder.py --max-iters 3   # smoke
+
+The single-process analog of the reference's Slurm experiment matrix: each
+config runs through the full profile -> allocate -> train path in a fresh
+subprocess (configs mutate env), and the table reports steady-state phase
+means from the runner's logs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONFIGS = [
+    "even_4",
+    "optimal_8",
+    "dynamic_8_stim",
+    "optimal_32_96layer",
+    "optimal_64_160layer",
+]
+
+
+def run_one(name: str, max_iters: int, log_root: str,
+            timeout: float = 3600) -> dict:
+    import shutil
+
+    # fresh logs per invocation: the runner's Logger appends, and stale
+    # lines from a previous ladder run would corrupt the phase means
+    shutil.rmtree(log_root, ignore_errors=True)
+
+    env = dict(os.environ)
+    env["SKYTPU_MAX_ITERS"] = str(max_iters)
+    env["SKYTPU_LOG_ROOT"] = log_root
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(HERE, "experiment", "launch.py"),
+             "-c", os.path.join(HERE, "experiment", "configs", f"{name}.py")],
+            env=env, capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"  {name}: timed out after {timeout:.0f}s")
+        return {"config": name, "exit": "timeout"}
+    if proc.returncode != 0 and proc.stderr:
+        tail = "\n".join(proc.stderr.splitlines()[-5:])
+        print(f"  {name} failed (exit {proc.returncode}); stderr tail:\n"
+              f"{tail}")
+    result = {"config": name, "exit": proc.returncode}
+
+    # find this run's allocation.log (layout encodes the matrix cell)
+    phase = re.compile(
+        r"forward time: ([\d.]+) \| backward time: ([\d.]+) \| "
+        r"step time: ([\d.]+)"
+    )
+    fwd, bwd, step = [], [], []
+    for root, _, files in os.walk(log_root):
+        for f in files:
+            if f != "allocation.log":
+                continue
+            for line in open(os.path.join(root, f)):
+                m = phase.search(line)
+                if m:
+                    fwd.append(float(m.group(1)))
+                    bwd.append(float(m.group(2)))
+                    step.append(float(m.group(3)))
+    if len(fwd) > 1:  # drop the compile-heavy first iteration
+        fwd, bwd, step = fwd[1:], bwd[1:], step[1:]
+    if fwd:
+        result.update(
+            fwd_s=sum(fwd) / len(fwd),
+            bwd_s=sum(bwd) / len(bwd),
+            step_s=sum(step) / len(step),
+            iters=len(fwd),
+        )
+    return result
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--only", nargs="*", default=None,
+                        help="subset of config names (without .py)")
+    parser.add_argument("--max-iters", type=int, default=5)
+    parser.add_argument("--log-root", default="/tmp/skytpu_ladder")
+    args = parser.parse_args()
+
+    names = args.only or CONFIGS
+    unknown = [n for n in names if n not in CONFIGS]
+    if unknown:
+        print(f"unknown configs: {unknown}; known: {CONFIGS}")
+        return 2
+
+    rows = []
+    for i, name in enumerate(names):
+        log_root = os.path.join(args.log_root, name)
+        print(f"[{i + 1}/{len(names)}] {name} ...", flush=True)
+        rows.append(run_one(name, args.max_iters, log_root))
+
+    print(f"\n{'config':24s} {'exit':>7s} {'fwd_s':>9s} {'bwd_s':>9s} "
+          f"{'step_s':>9s}")
+    for r in rows:
+        if "fwd_s" in r:
+            print(f"{r['config']:24s} {r['exit']!s:>7s} {r['fwd_s']:9.4f} "
+                  f"{r['bwd_s']:9.4f} {r['step_s']:9.4f}")
+        else:
+            print(f"{r['config']:24s} {r['exit']!s:>7s} {'-':>9s} {'-':>9s} "
+                  f"{'-':>9s}")
+    return 0 if all(r["exit"] == 0 for r in rows) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
