@@ -31,12 +31,19 @@ from .bert import BertSelfOutput, _cfg, _dense, _dtype
 
 
 class LongBertSelfAttention(nn.Module):
-    """Multi-head self-attention computed as a ring over the 'sp' axis."""
+    """Multi-head self-attention over the 'sp' axis.
+
+    ``strategy`` picks the communication pattern: ``"ring"`` (neighbor
+    ppermute, online softmax — O(L/S) memory) or ``"ulysses"`` (all-to-all
+    head-parallel — full softmax locally, needs heads divisible by the
+    axis size).
+    """
 
     config: Any
     deterministic: bool = False
     mesh: Any = None
     axis_name: str = "sp"
+    strategy: str = "ring"
 
     @nn.compact
     def __call__(self, hidden_states, attention_mask):
@@ -65,11 +72,22 @@ class LongBertSelfAttention(nn.Module):
         bias = attention_mask[:, 0, 0, :]
 
         if self.mesh is not None:
-            from ..parallel.ring_attention import ring_attention
+            if self.strategy == "ulysses":
+                from ..parallel.ulysses import ulysses_attention
 
-            context = ring_attention(
-                q, k, v, self.mesh, axis_name=self.axis_name, bias=bias
-            )
+                context = ulysses_attention(
+                    q, k, v, self.mesh, axis_name=self.axis_name, bias=bias
+                )
+            elif self.strategy == "ring":
+                from ..parallel.ring_attention import ring_attention
+
+                context = ring_attention(
+                    q, k, v, self.mesh, axis_name=self.axis_name, bias=bias
+                )
+            else:
+                raise ValueError(
+                    f"unknown sequence-parallel strategy {self.strategy!r}"
+                )
         else:
             from ..parallel.ring_attention import full_attention_reference
 
@@ -88,13 +106,14 @@ class LongBertLayer_Head(nn.Module):
     deterministic: bool = False
     mesh: Any = None
     axis_name: str = "sp"
+    strategy: str = "ring"
 
     @nn.compact
     def __call__(self, hidden_states, attention_mask):
         cfg = _cfg(self.config)
         self_out = LongBertSelfAttention(
             cfg.to_dict(), self.deterministic, self.mesh, self.axis_name,
-            name="self",
+            self.strategy, name="self",
         )(hidden_states, attention_mask)
         attn_out = BertSelfOutput(cfg.to_dict(), self.deterministic,
                                   name="output")(self_out, hidden_states)

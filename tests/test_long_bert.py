@@ -75,6 +75,27 @@ def test_long_head_rejects_attention_dropout(devices):
                     "dropout": jax.random.key(1)}, hidden, mask4)
 
 
+def test_ulysses_strategy_matches_ring(devices):
+    """Same params, both sequence-parallel strategies, same outputs."""
+    # ulysses needs heads divisible by the 8-device axis
+    cfg = bert_config("tiny", dtype="float32", hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0,
+                      max_position_embeddings=256, num_attention_heads=8)
+    mesh = _mesh(devices)
+    ring = build_layer("LongBertLayer_Head", config=cfg.to_dict(),
+                       deterministic=True, mesh=mesh, strategy="ring")
+    uly = build_layer("LongBertLayer_Head", config=cfg.to_dict(),
+                      deterministic=True, mesh=mesh, strategy="ulysses")
+    rng = np.random.default_rng(3)
+    hidden = rng.normal(size=(2, 256, 128)).astype(np.float32)
+    mask4 = np.zeros((2, 1, 1, 256), np.float32)
+    params = ring.init({"params": jax.random.key(0)}, hidden, mask4)
+    out_r, _ = ring.apply(params, hidden, mask4)
+    out_u, _ = uly.apply(params, hidden, mask4)
+    np.testing.assert_allclose(np.asarray(out_r), np.asarray(out_u),
+                               rtol=3e-5, atol=3e-6)
+
+
 def test_long_bert_grads_flow(devices):
     cfg = bert_config("tiny", dtype="float32", hidden_dropout_prob=0.0,
                       attention_probs_dropout_prob=0.0,
