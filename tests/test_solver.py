@@ -103,6 +103,58 @@ def test_heterogeneous_beats_even_bottleneck():
     assert result.bottleneck < even_bottleneck * 0.45
 
 
+@pytest.mark.parametrize("seed", range(10))
+def test_fuzz_invariants_hold(seed):
+    """Any feasible instance: full contiguous coverage, memory respected,
+    no device used twice, and exact (when available) never loses to the
+    polished greedy."""
+    rng = random.Random(100 + seed)
+    L = rng.randint(5, 60)
+    D = rng.randint(2, 24)
+    layer_cost = [rng.uniform(0.1, 3.0) for _ in range(L)]
+    layer_mem = [rng.uniform(0.1, 2.0) for _ in range(L)]
+    device_time = [rng.uniform(0.5, 6.0) for _ in range(D)]
+    total_mem = sum(layer_mem)
+    # per-device capacity >= total/D, so aggregate capacity always suffices;
+    # contiguity can still make an instance infeasible -> try/except below
+    device_mem = [rng.uniform(total_mem / D, total_mem) for _ in range(D)]
+
+    try:
+        res = solve_contiguous_minmax(
+            layer_cost, layer_mem, device_time, device_mem, tolerance=1e-6
+        )
+    except RuntimeError:
+        return  # genuinely infeasible instances are allowed to raise
+
+    # coverage: contiguous, disjoint, complete
+    ranges = sorted(res.slices)
+    pos = 0
+    for s, e in ranges:
+        assert s == pos and e > s
+        pos = e
+    assert pos == L
+    # distinct devices, memory respected, bottleneck consistent
+    assert len(set(res.device_order)) == len(res.device_order)
+    worst = 0.0
+    for d, (s, e) in zip(res.device_order, res.slices):
+        assert sum(layer_mem[s:e]) <= device_mem[d] + 1e-6
+        worst = max(worst, device_time[d] * sum(layer_cost[s:e]))
+    assert res.bottleneck == pytest.approx(worst, rel=1e-6)
+
+    # greedy never beats exact where exact runs (margin well above the
+    # solver's 1e-6 binary-search tolerance); the randomized greedy may
+    # also fail to cover an exact-feasible instance — that is allowed
+    if D <= 12:
+        try:
+            greedy = solve_contiguous_minmax(
+                layer_cost, layer_mem, device_time, device_mem,
+                tolerance=1e-6, exact_limit=0, use_native=False,
+            )
+        except RuntimeError:
+            return
+        assert res.bottleneck <= greedy.bottleneck * (1 + 1e-4)
+
+
 def test_large_cluster_greedy_path():
     rng = random.Random(7)
     L, D = 160, 64
