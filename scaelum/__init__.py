@@ -41,9 +41,18 @@ for _name in (
 ):
     _sys.modules[f"scaelum.{_name}"] = getattr(_impl, _name)
 
-# the reference exposed the model zoo as ``scaelum.model``
+# the reference exposed the model zoo as ``scaelum.model``, and timer/
+# logger as their own submodules (scaelum/timer/, scaelum/logger/)
 _sys.modules["scaelum.model"] = models
 model = models
+
+from skycomputing_tpu.utils import logger as _logger_mod
+from skycomputing_tpu.utils import timer as _timer_mod
+
+_sys.modules["scaelum.timer"] = _timer_mod
+_sys.modules["scaelum.logger"] = _logger_mod
+timer = _timer_mod
+logger = _logger_mod
 
 __version__ = _impl.__version__
 __all__ = list(getattr(_impl, "__all__", [])) + ["model"]

@@ -8,5 +8,8 @@ def test_scaelum_alias_imports():
     from scaelum.model import BertLayer_Head  # noqa: F401
     from scaelum.runner import Hook, Runner  # noqa: F401
     from scaelum.stimulator import Stimulator  # noqa: F401
+    # reference-layout submodules (scaelum/timer/, scaelum/logger/)
+    from scaelum.logger import Logger as L2  # noqa: F401
+    from scaelum.timer import DistributedTimer  # noqa: F401
 
     assert scaelum.__version__
