@@ -2,6 +2,12 @@ from .data_parallel import DataParallelPipeline
 from .mesh import make_dp_pp_mesh, make_pipeline_mesh
 from .multihost import global_mesh, initialize_from_env, is_coordinator
 from .ring_attention import full_attention_reference, ring_attention
+from .tensor_parallel import (
+    make_tp_mesh,
+    shard_params,
+    tp_shardings,
+    tp_train_step_fn,
+)
 from .ulysses import ulysses_attention
 from .pipeline import (
     PipelineModel,
@@ -24,4 +30,8 @@ __all__ = [
     "ring_attention",
     "full_attention_reference",
     "ulysses_attention",
+    "make_tp_mesh",
+    "shard_params",
+    "tp_shardings",
+    "tp_train_step_fn",
 ]

@@ -9,16 +9,23 @@ import jax
 from jax.sharding import Mesh
 
 
+def make_1d_mesh(
+    size: int, axis_name: str, devices: Optional[Sequence] = None
+) -> Mesh:
+    """A 1-D mesh of ``size`` devices under ``axis_name``."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) < size:
+        raise ValueError(
+            f"need {size} devices for the {axis_name} mesh, have {len(devs)}"
+        )
+    return Mesh(np.array(devs[:size]), axis_names=(axis_name,))
+
+
 def make_pipeline_mesh(
     num_stages: int, devices: Optional[Sequence] = None
 ) -> Mesh:
     """A 1-D ('pp',) mesh over the first ``num_stages`` devices."""
-    devs = list(devices) if devices is not None else jax.devices()
-    if len(devs) < num_stages:
-        raise ValueError(
-            f"need {num_stages} devices for the pipeline mesh, have {len(devs)}"
-        )
-    return Mesh(np.array(devs[:num_stages]), axis_names=("pp",))
+    return make_1d_mesh(num_stages, "pp", devices)
 
 
 def make_dp_pp_mesh(
@@ -36,4 +43,4 @@ def make_dp_pp_mesh(
     return Mesh(grid, axis_names=("dp", "pp"))
 
 
-__all__ = ["make_pipeline_mesh", "make_dp_pp_mesh"]
+__all__ = ["make_1d_mesh", "make_pipeline_mesh", "make_dp_pp_mesh"]
