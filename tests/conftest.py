@@ -17,13 +17,12 @@ _N_DEVICES = "8"
 # here — that's fine: execvpe replaces the process, and in the child the
 # scrubbed env means sitecustomize skips the TPU plugin entirely.
 if os.environ.get("SKYTPU_TEST_REEXEC") != "1":
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)  # disables the axon TPU plugin
-    env["JAX_PLATFORMS"] = "cpu"
-    flags = env.get("XLA_FLAGS", "")
-    env["XLA_FLAGS"] = (
-        flags + f" --xla_force_host_platform_device_count={_N_DEVICES}"
-    ).strip()
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from __graft_entry__ import scrubbed_env
+
+    env = scrubbed_env(int(_N_DEVICES))
     env["SKYTPU_TEST_REEXEC"] = "1"
     os.execvpe(
         sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env

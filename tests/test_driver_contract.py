@@ -1,9 +1,38 @@
 """Guards for the driver entry points and the config ladder."""
 
+import os
 import os.path as osp
 
 import jax
 import pytest
+
+
+def test_dryrun_multichip_survives_axon_env():
+    """dryrun_multichip must succeed even when the axon TPU plugin env is
+    present and the tunnel is dead (round 1 scored rc=124 from exactly
+    this).  Simulate the driver's world: axon env vars set, pointing at a
+    port where nothing listens."""
+    import subprocess
+    import sys
+
+    repo = osp.dirname(osp.dirname(osp.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("SKYTPU_TEST_REEXEC", None)
+    env.pop("SKYTPU_DRYRUN_REEXEC", None)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "axon"
+    env["PALLAS_AXON_POOL_IPS"] = "127.0.0.1"
+    env["PALLAS_AXON_REMOTE_COMPILE"] = "1"
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(4)"],
+        # above the wrapper's own 900s child timeout so a regression
+        # surfaces as the wrapper's RuntimeError (with rc + stderr), not
+        # a bare TimeoutExpired here
+        cwd=repo, env=env, capture_output=True, text=True, timeout=1200,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "one pipelined train step ok" in proc.stdout
 
 
 def test_graft_entry_shapes():
