@@ -22,19 +22,55 @@ def mse_loss(predictions, targets):
 
 
 @LOSS.register_module(name="CausalLmLoss")
-def causal_lm_loss(logits, labels):
-    """Next-token cross entropy; labels are the (unshifted) input ids."""
-    return optax.softmax_cross_entropy_with_integer_labels(
+def causal_lm_loss(logits, labels, mask=None, pad_id=None):
+    """Next-token cross entropy; labels are the (unshifted) input ids.
+
+    Padding must not be trained on: pass ``mask`` (1 = real token, aligned
+    with ``labels``) and/or ``pad_id`` (targets equal to it are ignored)
+    to get a masked mean over real target positions only.  With neither,
+    every position counts — correct only for unpadded batches.
+    """
+    per_token = optax.softmax_cross_entropy_with_integer_labels(
         logits[:, :-1].astype(jnp.float32), labels[:, 1:]
-    ).mean()
+    )
+    target_mask = None
+    if mask is not None:
+        target_mask = mask[:, 1:].astype(jnp.float32)
+    if pad_id is not None:
+        pad_mask = (labels[:, 1:] != pad_id).astype(jnp.float32)
+        target_mask = (
+            pad_mask if target_mask is None else target_mask * pad_mask
+        )
+    if target_mask is None:
+        return per_token.mean()
+    return (per_token * target_mask).sum() / jnp.maximum(
+        target_mask.sum(), 1.0
+    )
 
 
 def build_loss(loss_cfg: dict):
+    """Resolve ``{'type': <registry name>, **options}``; leftover options
+    are partial-applied (e.g. ``{'type': 'CausalLmLoss', 'pad_id': 0}``)."""
+    import functools
+    import inspect
+
     cfg = dict(loss_cfg)
     name = cfg.pop("type")
     fn = LOSS.get_module(name)
     if cfg:
-        raise ValueError(f"loss {name} takes no extra config, got {cfg}")
+        known = list(inspect.signature(fn).parameters)
+        unknown = [k for k in cfg if k not in known]
+        if unknown:
+            raise ValueError(f"loss {name} got unknown options {unknown}")
+        # the first two parameters (predictions, targets) are supplied at
+        # call time; binding them here would only surface as a confusing
+        # TypeError inside the first jitted train step
+        shadowed = [k for k in cfg if k in known[:2]]
+        if shadowed:
+            raise ValueError(
+                f"loss {name} options {shadowed} shadow call-time arguments"
+            )
+        fn = functools.partial(fn, **cfg)
     return fn
 
 

@@ -47,6 +47,7 @@ class DataParallelPipeline:
         devices: Optional[Sequence[Any]] = None,
         devices_per_replica: Optional[int] = None,
         num_microbatches: int = 1,
+        schedule: str = "gpipe",
     ):
         devices = list(devices) if devices is not None else jax.devices()
         if devices_per_replica is None:
@@ -70,6 +71,9 @@ class DataParallelPipeline:
                     r * devices_per_replica : (r + 1) * devices_per_replica
                 ],
                 num_microbatches=num_microbatches,
+                # replicas' compute_gradients dispatches on this, so 1f1b's
+                # depth-bounded activation memory survives DP replication
+                schedule=schedule,
             )
             for r in range(num_replicas)
         ]

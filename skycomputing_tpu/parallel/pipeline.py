@@ -284,6 +284,7 @@ class PipelineModel:
         self.schedule = schedule
         self.stats = PipelineStats()
         self._train = True
+        self._fwd_call_count = 0
 
         self.stages: List[StageRuntime] = []
         self._build_stages()
@@ -362,9 +363,16 @@ class PipelineModel:
 
     # --- execution ----------------------------------------------------------
     def forward(self, data, rng: Optional[jax.Array] = None):
-        """Inference/eval forward of one full batch (no microbatching)."""
+        """Inference/eval forward of one full batch (no microbatching).
+
+        In train mode with no explicit ``rng``, each call folds a
+        monotonically increasing counter into a fixed base key, so repeated
+        calls draw fresh dropout masks (a bare ``key(0)`` default would
+        silently reuse the same mask every call).
+        """
         if rng is None and self._train:
-            rng = jax.random.key(0)
+            rng = jax.random.fold_in(jax.random.key(0), self._fwd_call_count)
+            self._fwd_call_count += 1
         acts = as_tuple(data)
         for k, stage in enumerate(self.stages):
             stage_rng = (
@@ -389,8 +397,6 @@ class PipelineModel:
         last stage, capping per-stage live inputs at the pipeline depth
         instead of M.
         """
-        if self.schedule == "1f1b" and self.num_microbatches > 1:
-            return self._train_step_1f1b(data, labels, rng)
         grad_totals, losses, (t0, t1, t2) = self.compute_gradients(
             data, labels, rng
         )
@@ -401,9 +407,15 @@ class PipelineModel:
         total_loss = float(sum(jax.device_get(l) for l in losses))
         self.stats = PipelineStats(
             forward_s=t1 - t0, backward_s=t2 - t1, step_s=t3 - t2,
-            loss=total_loss,
+            loss=total_loss, interleaved=self._interleaved,
         )
         return total_loss
+
+    @property
+    def _interleaved(self) -> bool:
+        """True when gradients come from the fused-fwd/bwd 1F1B path (the
+        single source for both schedule dispatch and stats labeling)."""
+        return self.schedule == "1f1b" and self.num_microbatches > 1
 
     def compute_gradients(
         self,
@@ -412,16 +424,31 @@ class PipelineModel:
         rng: Optional[jax.Array] = None,
         block: bool = True,
     ):
-        """GPipe fwd/bwd without the update: (per-stage grad totals,
-        per-microbatch scaled losses, phase timestamps).
+        """Schedule-dispatched fwd/bwd without the update: (per-stage grad
+        totals, per-microbatch scaled losses, phase timestamps).
 
         The split from ``apply_gradients`` is what data-parallel replication
         builds on: replicas compute grads independently, average, then each
-        applies the same averaged update.  ``block=False`` skips the
-        per-phase ``block_until_ready`` barriers so a caller can dispatch
+        applies the same averaged update — under EITHER schedule (1F1B's
+        depth-bounded activation memory survives DP replication because
+        the dispatch happens here, not in ``train_step``).  ``block=False``
+        skips the ``block_until_ready`` barriers so a caller can dispatch
         several replicas' work before any of it completes (the timestamps
-        then measure dispatch, not compute).
+        then measure dispatch, not compute).  Under 1F1B forward/backward
+        interleave, so the middle timestamp equals the last one and the
+        fused time reads as "forward".
         """
+        if self._interleaved:
+            return self._compute_gradients_1f1b(data, labels, rng, block)
+        return self._compute_gradients_gpipe(data, labels, rng, block)
+
+    def _compute_gradients_gpipe(
+        self,
+        data,
+        labels,
+        rng: Optional[jax.Array] = None,
+        block: bool = True,
+    ):
         if rng is None:
             rng = jax.random.key(int(time.time_ns() % (2**31)))
         M = self.num_microbatches
@@ -480,7 +507,7 @@ class PipelineModel:
         for k, stage in enumerate(self.stages):
             stage.apply_gradients(grad_totals[k])
 
-    def _train_step_1f1b(self, data, labels, rng) -> float:
+    def _compute_gradients_1f1b(self, data, labels, rng, block: bool = True):
         """One-forward-one-backward schedule: issue each microbatch's
         backward as soon as its forward drains the last stage.
 
@@ -584,19 +611,13 @@ class PipelineModel:
             if not progressed:  # pragma: no cover - schedule deadlock guard
                 raise RuntimeError("1F1B schedule made no progress")
 
-        jax.block_until_ready(grad_totals[0])
+        if block:
+            jax.block_until_ready(grad_totals[0])
         t2 = time.perf_counter()
-        for k, stage in enumerate(self.stages):
-            stage.apply_gradients(grad_totals[k])
-        jax.block_until_ready(self.stages[0].params)
-        t3 = time.perf_counter()
-
-        total_loss = float(sum(jax.device_get(l) for l in losses))
-        self.stats = PipelineStats(
-            forward_s=t2 - t0, backward_s=0.0, step_s=t3 - t2,
-            loss=total_loss, interleaved=True,
-        )
-        return total_loss
+        # fused fwd/bwd: report (t0, t2, t2) so forward_s carries the whole
+        # interleaved time and backward_s reads 0, as the stats contract
+        # for interleaved schedules expects
+        return grad_totals, losses, (t0, t2, t2)
 
     # --- profiling ----------------------------------------------------------
     def measure_stage_times(

@@ -43,6 +43,7 @@ class CheckpointHook(Hook):
         # Training state is partition-DEPENDENT; restore requires the same
         # allocation, while the params file stays partition-independent.
         self._save_training_state = save_training_state
+        self._last_saved_iter = 0
 
     @staticmethod
     def _training_state_path(params_path: str) -> str:
@@ -92,16 +93,30 @@ class CheckpointHook(Hook):
             return
         if not self.every_n_epochs(runner, self._save_interval):
             return
-        os.makedirs(self._save_path, exist_ok=True)
-        runner.model.sync_to_parameter_server()
         # after_epoch runs after the runner increments epoch, so runner.epoch
         # is already the 1-based count of completed epochs
+        self._save(runner, f"epoch_{runner.epoch}")
+
+    def after_run(self, runner):
+        # a run that ends mid-epoch (max_iters, stop request) never fires
+        # after_epoch for the partial epoch; persist the trained weights
+        # under an iter-tagged name so they survive without masquerading
+        # as a completed epoch
+        if not self._save_path or not self._save_interval:
+            return
+        if runner.iter > self._last_saved_iter:
+            self._save(runner, f"iter_{runner.iter}")
+
+    def _save(self, runner, tag: str) -> None:
+        os.makedirs(self._save_path, exist_ok=True)
+        runner.model.sync_to_parameter_server()
         if self._format == "orbax":
-            path = osp.join(self._save_path, f"epoch_{runner.epoch}")
+            path = osp.join(self._save_path, tag)
             runner.parameter_server.save_orbax(path)
         else:
-            path = osp.join(self._save_path, f"epoch_{runner.epoch}.msgpack")
+            path = osp.join(self._save_path, f"{tag}.msgpack")
             runner.parameter_server.save_weights_to_file(path)
+        self._last_saved_iter = runner.iter
         runner.logger.info(f"saved checkpoint to {path}")
 
         if self._save_training_state:

@@ -145,9 +145,11 @@ class Runner:
         while self._epoch < self._max_epochs and not self._stop:
             self._call_hook("before_train_epoch")
             self._inner_iter = 0
+            exhausted = True
 
             for data, labels in data_loader:
                 if self._iter >= self._max_iters or self._stop:
+                    exhausted = False
                     break
 
                 self._logger.info(
@@ -182,6 +184,13 @@ class Runner:
                 self._inner_iter += 1
                 self._call_hook("after_train_iter")
 
+            if not exhausted:
+                # max_iters / stop interrupted the epoch mid-stream: the
+                # epoch did NOT complete, so don't count it and don't fire
+                # after_train_epoch (a CheckpointHook there would label a
+                # partial epoch as finished and a resume would skip the
+                # rest of its data)
+                break
             self._epoch += 1
             self._call_hook("after_train_epoch")
             if self._iter >= self._max_iters:

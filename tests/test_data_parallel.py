@@ -104,6 +104,31 @@ def test_dp_checkpoint_resume_through_hook(devices, tmp_path):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_dp_1f1b_schedule_matches_gpipe(devices):
+    """schedule='1f1b' plumbs through to the replicas and computes the
+    same step as GPipe (same math, different issue order)."""
+    wm, ps, data, labels = build(devices, seed=7)
+    dp_1f1b = DataParallelPipeline(
+        wm, ps, optax.sgd(1e-2), cross_entropy_loss, num_replicas=2,
+        devices=devices, num_microbatches=2, schedule="1f1b",
+    )
+    assert all(m.schedule == "1f1b" for m in dp_1f1b.replicas)
+    wm2, ps2, *_ = build(devices, seed=7)
+    dp_gpipe = DataParallelPipeline(
+        wm2, ps2, optax.sgd(1e-2), cross_entropy_loss, num_replicas=2,
+        devices=devices, num_microbatches=2, schedule="gpipe",
+    )
+    l1 = dp_1f1b.train_step(data, labels, rng=jax.random.key(0))
+    l2 = dp_gpipe.train_step(data, labels, rng=jax.random.key(0))
+    assert l1 == pytest.approx(l2, rel=1e-5)
+    for s_a, s_b in zip(dp_1f1b.replicas[0].stages,
+                        dp_gpipe.replicas[0].stages):
+        for a, b in zip(jax.tree_util.tree_leaves(s_a.params),
+                        jax.tree_util.tree_leaves(s_b.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-7)
+
+
 def test_too_few_devices_rejected(devices):
     wm, ps, *_ = build(devices)
     with pytest.raises(ValueError, match="need 12 devices"):

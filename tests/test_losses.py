@@ -1,0 +1,71 @@
+"""Loss functions: padding-aware causal LM loss + build_loss options."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from skycomputing_tpu.ops import build_loss
+from skycomputing_tpu.ops.losses import causal_lm_loss
+
+
+def _make_batch(pad_id=0, seed=0):
+    rng = np.random.default_rng(seed)
+    logits = jnp.asarray(rng.normal(size=(2, 6, 11)).astype(np.float32))
+    labels = np.array([[5, 3, 7, 2, pad_id, pad_id],
+                       [4, 9, pad_id, pad_id, pad_id, pad_id]], np.int32)
+    return logits, jnp.asarray(labels)
+
+
+def test_causal_lm_loss_pad_id_masks_padding_targets():
+    logits, labels = _make_batch()
+    per_token = optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1], labels[:, 1:]
+    )
+    valid = np.asarray(labels[:, 1:] != 0, np.float32)
+    expected = float((np.asarray(per_token) * valid).sum() / valid.sum())
+    got = float(causal_lm_loss(logits, labels, pad_id=0))
+    assert got == pytest.approx(expected, rel=1e-6)
+    # and differs from the unmasked mean (padding would otherwise count)
+    assert got != pytest.approx(float(causal_lm_loss(logits, labels)))
+
+
+def test_causal_lm_loss_explicit_mask_matches_pad_id():
+    logits, labels = _make_batch()
+    mask = (labels != 0).astype(jnp.int32)
+    via_mask = float(causal_lm_loss(logits, labels, mask=mask))
+    via_pad = float(causal_lm_loss(logits, labels, pad_id=0))
+    assert via_mask == pytest.approx(via_pad, rel=1e-6)
+
+
+def test_causal_lm_loss_all_padding_stays_finite():
+    logits, labels = _make_batch()
+    all_pad = jnp.zeros_like(labels)
+    out = float(causal_lm_loss(logits, all_pad, pad_id=0))
+    assert np.isfinite(out) and out == 0.0
+
+
+def test_build_loss_partial_applies_options():
+    logits, labels = _make_batch()
+    fn = build_loss({"type": "CausalLmLoss", "pad_id": 0})
+    direct = float(causal_lm_loss(logits, labels, pad_id=0))
+    assert float(fn(logits, labels)) == pytest.approx(direct, rel=1e-6)
+
+
+def test_build_loss_rejects_unknown_options():
+    with pytest.raises(ValueError, match="unknown options"):
+        build_loss({"type": "CausalLmLoss", "bogus": 1})
+
+
+def test_build_loss_rejects_call_time_argument_shadowing():
+    """Binding logits/labels in config would TypeError at the first train
+    step; it must fail loudly at config time instead."""
+    with pytest.raises(ValueError, match="shadow call-time"):
+        build_loss({"type": "CausalLmLoss", "labels": 0})
+
+
+def test_masked_loss_is_jittable():
+    logits, labels = _make_batch()
+    fn = jax.jit(lambda lg, lb: causal_lm_loss(lg, lb, pad_id=0))
+    assert np.isfinite(float(fn(logits, labels)))

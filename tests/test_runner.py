@@ -85,6 +85,86 @@ def test_runner_trains_and_calls_hooks(devices):
     assert runner.phase_timer.mean("forward") > 0
 
 
+def test_interrupted_epoch_not_counted_as_completed(devices):
+    """max_iters stopping mid-epoch must not increment epoch or fire
+    after_train_epoch — a CheckpointHook there would label a partial
+    epoch as finished and a resume would skip the rest of its data."""
+    model, ps, wm, loader = build_world(devices)
+    # loader yields 8 batches/epoch; cut off after 3
+    runner = Runner(model, ps, wm, max_epochs=5, max_iters=3)
+    completed = []
+
+    class Recorder(Hook):
+        def after_train_epoch(self, r):
+            completed.append(r.epoch)
+
+    runner.register_hook(Recorder())
+    runner.train(_BatchAdapter(loader))
+    assert runner.iter == 3
+    assert runner.epoch == 0  # the interrupted epoch never completed
+    assert completed == []
+
+
+def test_interrupted_run_still_persists_weights(devices, tmp_path):
+    """A max_iters cutoff mid-epoch saves an iter-tagged checkpoint (not an
+    epoch-labeled one) so the run's training is not silently discarded."""
+    import os
+
+    model, ps, wm, loader = build_world(devices, seed=3)
+    save_dir = str(tmp_path / "partial")
+    runner = Runner(model, ps, wm, max_epochs=1, max_iters=3)
+    runner.register_hook(CheckpointHook(save_path=save_dir, save_interval=1))
+    runner.train(_BatchAdapter(loader))  # 8 batches/epoch, cut at 3
+    assert sorted(os.listdir(save_dir)) == ["iter_3.msgpack"]
+
+    # the partial checkpoint restores like any other
+    model2, ps2, wm2, loader2 = build_world(devices, n_workers=2, seed=9)
+    runner2 = Runner(model2, ps2, wm2, max_epochs=0, max_iters=0)
+    runner2.register_hook(CheckpointHook(
+        load_checkpoint_from=osp.join(save_dir, "iter_3.msgpack")))
+    runner2.train(_BatchAdapter(loader2))
+    batch = next(iter(_BatchAdapter(loader)))
+    np.testing.assert_allclose(
+        np.asarray(model.forward(batch[0])),
+        np.asarray(model2.forward(batch[0])),
+        rtol=2e-4, atol=2e-5,
+    )
+
+
+def test_completed_epochs_do_not_double_save(devices, tmp_path):
+    """A run whose last epoch checkpointed normally must not also emit an
+    iter-tagged file from after_run."""
+    import os
+
+    model, ps, wm, loader = build_world(devices)
+    save_dir = str(tmp_path / "full")
+    runner = Runner(model, ps, wm, max_epochs=1, max_iters=100)
+    runner.register_hook(CheckpointHook(save_path=save_dir, save_interval=1))
+    runner.train(list(_BatchAdapter(loader))[:2])
+    assert sorted(os.listdir(save_dir)) == ["epoch_1.msgpack"]
+
+
+def test_train_mode_default_rng_gives_fresh_dropout_masks(devices):
+    """Two no-rng train-mode forwards must not reuse one dropout mask."""
+    cfg = bert_config("tiny", dtype="float32")  # dropout prob 0.1, live
+    model_cfg = bert_layer_configs(cfg, num_encoder_units=1, num_classes=3,
+                                   deterministic=False)
+    wm = WorkerManager()
+    wm.load_worker_pool_from_config(
+        [dict(name=f"n{i}", device_config=dict(device_index=i),
+              extra_config={}) for i in range(2)]
+    )
+    Allocator(model_cfg, wm, None, None).even_allocate()
+    ids = np.ones((2, 8), np.int32)
+    ps = ParameterServer(model_cfg, example_inputs=(ids, ids * 0, ids * 0 + 1))
+    model = PipelineModel(wm, ps, optax.sgd(1e-2), cross_entropy_loss,
+                          devices=devices)
+    model.train(True)
+    a = np.asarray(model.forward((ids, ids * 0, ids * 0 + 1)))
+    b = np.asarray(model.forward((ids, ids * 0, ids * 0 + 1)))
+    assert not np.allclose(a, b)
+
+
 def test_stop_hook_interrupts_training(devices, tmp_path):
     model, ps, wm, loader = build_world(devices)
     runner = Runner(model, ps, wm, max_epochs=10, max_iters=100)
@@ -104,11 +184,13 @@ def test_stop_hook_interrupts_training(devices, tmp_path):
 def test_checkpoint_hook_saves_and_restores(devices, tmp_path):
     model, ps, wm, loader = build_world(devices, seed=1)
     save_dir = str(tmp_path / "ckpts")
-    runner = Runner(model, ps, wm, max_epochs=1, max_iters=3)
+    runner = Runner(model, ps, wm, max_epochs=1, max_iters=100)
     runner.register_hook(
         CheckpointHook(save_path=save_dir, save_interval=1)
     )
-    runner.train(_BatchAdapter(loader))
+    # an epoch must COMPLETE for its checkpoint to exist (interrupted
+    # epochs are deliberately not checkpointed) — train on 3 batches
+    runner.train(list(_BatchAdapter(loader))[:3])
     ckpt = osp.join(save_dir, "epoch_1.msgpack")
     assert osp.exists(ckpt)
 
@@ -129,11 +211,11 @@ def test_checkpoint_hook_saves_and_restores(devices, tmp_path):
 def test_orbax_checkpoint_roundtrip_across_partitions(devices, tmp_path):
     """Orbax format: save from a 3-way world, restore into 2-way."""
     model, ps, wm, loader = build_world(devices, seed=5)
-    runner = Runner(model, ps, wm, max_epochs=1, max_iters=2)
+    runner = Runner(model, ps, wm, max_epochs=1, max_iters=100)
     save_dir = str(tmp_path / "ockpts")
     runner.register_hook(CheckpointHook(save_path=save_dir, save_interval=1,
                                         format="orbax"))
-    runner.train(_BatchAdapter(loader))
+    runner.train(list(_BatchAdapter(loader))[:2])
     ckpt = osp.join(save_dir, "epoch_1")
     assert osp.isdir(ckpt)
 
