@@ -1,5 +1,5 @@
 from .data_parallel import DataParallelPipeline
-from .mesh import make_dp_pp_mesh, make_pipeline_mesh
+from .mesh import make_dp_pp_mesh, make_dp_pp_tp_mesh, make_pipeline_mesh
 from .multihost import global_mesh, initialize_from_env, is_coordinator
 from .ring_attention import full_attention_reference, ring_attention
 from .tensor_parallel import (
@@ -19,6 +19,7 @@ from .pipeline import (
 __all__ = [
     "DataParallelPipeline",
     "make_dp_pp_mesh",
+    "make_dp_pp_tp_mesh",
     "make_pipeline_mesh",
     "PipelineModel",
     "PipelineStats",

@@ -43,4 +43,25 @@ def make_dp_pp_mesh(
     return Mesh(grid, axis_names=("dp", "pp"))
 
 
-__all__ = ["make_1d_mesh", "make_pipeline_mesh", "make_dp_pp_mesh"]
+def make_dp_pp_tp_mesh(
+    dp: int, pp: int, tp: int, devices: Optional[Sequence] = None
+) -> Mesh:
+    """('dp', 'pp', 'tp') mesh for 3-D parallel pipelines.
+
+    tp innermost so the per-layer psums ride the fastest ICI links; pp next
+    so stage handoffs stay neighbor-local; dp outermost (cheapest axis —
+    one gradient reduction per step).
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) < dp * pp * tp:
+        raise ValueError(f"need {dp * pp * tp} devices, have {len(devs)}")
+    grid = np.array(devs[: dp * pp * tp]).reshape(dp, pp, tp)
+    return Mesh(grid, axis_names=("dp", "pp", "tp"))
+
+
+__all__ = [
+    "make_1d_mesh",
+    "make_pipeline_mesh",
+    "make_dp_pp_mesh",
+    "make_dp_pp_tp_mesh",
+]

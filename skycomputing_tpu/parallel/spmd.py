@@ -40,6 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 import flax.linen as nn
 
 from ..models.bert import (
+    ACT2FN,
     BertEmbeddings,
     BertLayer_Body,
     BertLayer_Head,
@@ -91,6 +92,203 @@ class EncoderStage(nn.Module):
         return hidden, mask
 
 
+class _TpDense(nn.Module):
+    """Tensor-parallel dense holding this device's weight shard.
+
+    ``col``: output features sharded over the tp axis (no collective);
+    ``row``: input features sharded, partial products ``psum``-reduced over
+    the tp axis before the (replicated) bias is added.  The param tree keeps
+    the plain Dense layout (``kernel``/``bias``) so full weights split into
+    tp shards by pure reshape/transpose (see ``split_stage_params_for_tp``).
+    """
+
+    out_features: int
+    dtype: Any
+    mode: str  # 'col' | 'row'
+    axis_name: str = "tp"
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", nn.initializers.zeros,
+            (x.shape[-1], self.out_features), jnp.float32,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, (self.out_features,), jnp.float32
+        )
+        y = x @ kernel.astype(self.dtype)
+        if self.mode == "row":
+            y = lax.psum(y, self.axis_name)
+        return y + bias.astype(self.dtype)
+
+
+class TpEncoderUnit(nn.Module):
+    """Megatron-style tensor-parallel encoder trio for the pipeline body.
+
+    Attention q/k/v are column-parallel (heads split across tp), the
+    attention output projection and the FFN down-projection are
+    row-parallel with a ``psum``; LayerNorms and residuals are replicated.
+    Param tree mirrors :class:`EncoderUnit` (``head/self/query`` etc.) with
+    tp-local leaf shapes.  Deterministic only (the compiled pipeline body
+    never applies dropout).
+    """
+
+    config: Any
+    tp: int
+    axis_name: str = "tp"
+
+    @nn.compact
+    def __call__(self, hidden, mask):
+        cfg = BertConfig.from_dict(self.config)
+        dtype = jnp.dtype(cfg.dtype)
+        if (
+            cfg.hidden_size % self.tp
+            or cfg.num_attention_heads % self.tp
+            or cfg.intermediate_size % self.tp
+        ):
+            raise ValueError(
+                f"hidden/heads/intermediate "
+                f"({cfg.hidden_size}/{cfg.num_attention_heads}/"
+                f"{cfg.intermediate_size}) must all be divisible by "
+                f"tp={self.tp}"
+            )
+        n_heads = cfg.num_attention_heads // self.tp
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        h_local = cfg.hidden_size // self.tp
+        i_local = cfg.intermediate_size // self.tp
+
+        class Head(nn.Module):
+            @nn.compact
+            def __call__(sf, hidden, mask):
+                class Self(nn.Module):
+                    @nn.compact
+                    def __call__(sf2, x, mask):
+                        mk = lambda nm: _TpDense(
+                            h_local, dtype, "col", self.axis_name, name=nm
+                        )
+                        split = lambda t: t.reshape(
+                            t.shape[0], t.shape[1], n_heads, head_dim
+                        )
+                        q = split(mk("query")(x))
+                        k = split(mk("key")(x))
+                        v = split(mk("value")(x))
+                        scores = jnp.einsum("blhd,bmhd->bhlm", q, k) / (
+                            jnp.sqrt(jnp.asarray(head_dim, dtype))
+                        )
+                        scores = scores + mask
+                        probs = jax.nn.softmax(
+                            scores.astype(jnp.float32), axis=-1
+                        ).astype(dtype)
+                        ctx = jnp.einsum("bhlm,bmhd->blhd", probs, v)
+                        return ctx.reshape(ctx.shape[0], ctx.shape[1],
+                                           h_local)
+
+                class Out(nn.Module):
+                    @nn.compact
+                    def __call__(sf2, ctx, residual):
+                        y = _TpDense(cfg.hidden_size, dtype, "row",
+                                     self.axis_name, name="dense")(ctx)
+                        out = nn.LayerNorm(
+                            epsilon=1e-12, dtype=jnp.float32,
+                            name="LayerNorm",
+                        )(y + residual)
+                        return out.astype(dtype)
+
+                ctx = Self(name="self")(hidden, mask)
+                return Out(name="output")(ctx, hidden), mask
+
+        class Body(nn.Module):
+            @nn.compact
+            def __call__(sf, attn_out, mask):
+                act = ACT2FN[cfg.hidden_act]
+                inter = act(_TpDense(i_local, dtype, "col", self.axis_name,
+                                     name="dense_act")(attn_out))
+                return inter, attn_out, mask
+
+        class Tail(nn.Module):
+            @nn.compact
+            def __call__(sf, inter, attn_out, mask):
+                y = _TpDense(cfg.hidden_size, dtype, "row", self.axis_name,
+                             name="dense")(inter)
+                out = nn.LayerNorm(
+                    epsilon=1e-12, dtype=jnp.float32, name="LayerNorm"
+                )(y + attn_out)
+                return out.astype(dtype), mask
+
+        hidden, mask = Head(name="head")(hidden, mask)
+        inter, attn, mask = Body(name="body")(hidden, mask)
+        return Tail(name="tail")(inter, attn, mask)
+
+
+class TpEncoderStage(nn.Module):
+    """``units`` tensor-parallel encoder trios; remat like EncoderStage."""
+
+    config: Any
+    units: int
+    tp: int
+    axis_name: str = "tp"
+
+    @nn.compact
+    def __call__(self, hidden, mask):
+        for u in range(self.units):
+            hidden, mask = nn.remat(TpEncoderUnit)(
+                self.config, self.tp, self.axis_name, name=f"unit_{u}"
+            )(hidden, mask)
+        return hidden, mask
+
+
+def _leaf_role(path) -> Tuple[str, str]:
+    keys = [getattr(p, "key", str(p)) for p in path]
+    return keys[-2], keys[-1]  # (module, param) e.g. ('query', 'kernel')
+
+
+def split_stage_params_for_tp(stages, tp: int):
+    """[P, ...full...] stacked stage params -> [P, tp, ...local...].
+
+    Column-parallel leaves (q/k/v, FFN up) slice output features; row-
+    parallel kernels (attention out, FFN down) slice input features; biases
+    of row-parallel layers and LayerNorms replicate across tp.
+    """
+
+    def split(path, leaf):
+        module, param = _leaf_role(path)
+        P_ = leaf.shape[0]
+        if module in ("query", "key", "value", "dense_act"):
+            if param == "kernel":
+                i, o = leaf.shape[1:]
+                return leaf.reshape(P_, i, tp, o // tp).transpose(0, 2, 1, 3)
+            o = leaf.shape[1]
+            return leaf.reshape(P_, tp, o // tp)
+        if module == "dense" and param == "kernel":
+            i, o = leaf.shape[1:]
+            return leaf.reshape(P_, tp, i // tp, o)
+        # row-parallel bias, LayerNorm scale/bias: replicate
+        return jnp.broadcast_to(
+            leaf[:, None], (P_, tp) + leaf.shape[1:]
+        )
+
+    return jax.tree_util.tree_map_with_path(split, stages)
+
+
+def merge_stage_params_from_tp(stages_tp):
+    """Inverse of :func:`split_stage_params_for_tp`."""
+
+    def merge(path, leaf):
+        module, param = _leaf_role(path)
+        P_, tp = leaf.shape[:2]
+        if module in ("query", "key", "value", "dense_act"):
+            if param == "kernel":
+                i, o = leaf.shape[2:]
+                return leaf.transpose(0, 2, 1, 3).reshape(P_, i, tp * o)
+            return leaf.reshape(P_, -1)
+        if module == "dense" and param == "kernel":
+            i, o = leaf.shape[2:]
+            return leaf.reshape(P_, tp * i, o)
+        return leaf[:, 0]
+
+    return jax.tree_util.tree_map_with_path(merge, stages_tp)
+
+
 class CompiledBertPipeline:
     """BERT classifier with the encoder pipelined across a ('pp',) mesh."""
 
@@ -123,6 +321,9 @@ class CompiledBertPipeline:
         # in_spec P('pp') omits 'dp', so the cotangent is psummed over it);
         # GSPMD handles only the code outside the shard_map.
         self.dp = int(mesh.shape["dp"]) if "dp" in mesh.shape else 1
+        # optional tensor-parallel axis: each stage's weights sharded
+        # Megatron-style over 'tp' with explicit psums in the stage body
+        self.tp = int(mesh.shape["tp"]) if "tp" in mesh.shape else 1
         self.units_per_stage = units_per_stage
         self.num_classes = num_classes
         self.num_microbatches = num_microbatches or self.num_stages
@@ -136,6 +337,10 @@ class CompiledBertPipeline:
         cfg_dict = self.cfg.to_dict()
         self.embeddings = BertEmbeddings(cfg_dict, deterministic=True)
         self.stage = EncoderStage(cfg_dict, units_per_stage)
+        self.tp_stage = (
+            TpEncoderStage(cfg_dict, units_per_stage, self.tp)
+            if self.tp > 1 else None
+        )
         self.pooler = BertPooler(cfg_dict, deterministic=True)
         self.classifier = BertTailForClassification(
             hidden_dropout_prob=self.cfg.hidden_dropout_prob,
@@ -145,7 +350,7 @@ class CompiledBertPipeline:
             dtype=self.cfg.dtype,
         )
 
-        self._stage_spec = P("pp")
+        self._stage_spec = P("pp", "tp") if self.tp > 1 else P("pp")
         self._repl_spec = P()
         self.param_shardings: Optional[Dict] = None
         self._train_step = None
@@ -171,6 +376,9 @@ class CompiledBertPipeline:
         # leading axis over 'pp' gives each device chunks {d, S+d, 2S+d,...}
         order = [(p % V) * S + p // V for p in range(S * V)]
         stages = jax.vmap(init_one_stage)(chunk_keys[jnp.asarray(order)])
+        if self.tp > 1:
+            # full weights -> per-device Megatron shards on a new axis 1
+            stages = split_stage_params_for_tp(stages, self.tp)
 
         pooler_vars = self.pooler.init({"params": k_pool}, hidden, mask4)
         pooled = self.pooler.apply(pooler_vars, hidden, mask4)
@@ -224,12 +432,15 @@ class CompiledBertPipeline:
         """shard_map GPipe: [M, mb, L, H] -> [M, mb, L, H]."""
         S = self.num_stages
         M = self.num_microbatches
-        stage_mod = self.stage
+        tp = self.tp
+        stage_mod = self.tp_stage if tp > 1 else self.stage
 
         def body(local_stage_params, hidden_mb, mask_mb):
-            # local leaves have leading dim 1 (this device's stage)
+            # local leaves have leading dim 1 (this device's stage); with
+            # tensor parallelism a second singleton tp-shard dim follows
             params = jax.tree_util.tree_map(
-                lambda x: x[0], local_stage_params
+                (lambda x: x[0, 0]) if tp > 1 else (lambda x: x[0]),
+                local_stage_params,
             )
             idx = lax.axis_index("pp")
             fwd_perm = [(i, (i + 1) % S) for i in range(S)]
@@ -274,7 +485,8 @@ class CompiledBertPipeline:
         S, V, M = self.num_stages, self.virtual_stages, self.num_microbatches
         C = S * V
         T = M + C - 1
-        stage_mod = self.stage
+        tp = self.tp
+        stage_mod = self.tp_stage if tp > 1 else self.stage
 
         def body(local_stage_params, hidden_mb, mask_mb):
             d = lax.axis_index("pp")
@@ -291,11 +503,12 @@ class CompiledBertPipeline:
                 k_c = jnp.clip(k, 0, V - 1)
                 m_c = jnp.clip(m, 0, M - 1)
 
+                def index_chunk(x):
+                    x = lax.dynamic_index_in_dim(x, k_c, 0, keepdims=False)
+                    return x[0] if tp > 1 else x
+
                 params_k = jax.tree_util.tree_map(
-                    lambda x: lax.dynamic_index_in_dim(
-                        x, k_c, 0, keepdims=False
-                    ),
-                    local_stage_params,
+                    index_chunk, local_stage_params
                 )
                 is_first_chunk = (d == 0) & (k_c == 0)
                 inp = jnp.where(is_first_chunk, hidden_mb[m_c], recv)
@@ -382,4 +595,11 @@ class CompiledBertPipeline:
         return self._train_step(params, opt_state, batch, labels)
 
 
-__all__ = ["CompiledBertPipeline", "EncoderStage"]
+__all__ = [
+    "CompiledBertPipeline",
+    "EncoderStage",
+    "TpEncoderStage",
+    "TpEncoderUnit",
+    "split_stage_params_for_tp",
+    "merge_stage_params_from_tp",
+]

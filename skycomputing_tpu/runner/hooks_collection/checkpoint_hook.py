@@ -104,6 +104,13 @@ class CheckpointHook(Hook):
         # as a completed epoch
         if not self._save_path or not self._save_interval:
             return
+        if getattr(runner, "aborted", False):
+            # training raised (NaN guard, interrupt): the live params are
+            # suspect — leave the last good checkpoint as the newest one
+            runner.logger.info(
+                "training aborted; skipping final checkpoint save"
+            )
+            return
         if runner.iter > self._last_saved_iter:
             self._save(runner, f"iter_{runner.iter}")
 

@@ -132,13 +132,21 @@ class Runner:
     def train(self, data_loader) -> None:
         self.data_loader = data_loader
         self.model.train(True)
+        self.aborted = False
         self._call_hook("before_run")
         try:
             self._train_loop(data_loader)
+        except Exception:
+            # a training *error* (NanGuardHook action="raise", data
+            # corruption) marks the live params suspect so CheckpointHook
+            # skips its final save; KeyboardInterrupt is deliberately NOT
+            # Exception — a user interrupt's params are fine and the
+            # partial-epoch save should still happen
+            self.aborted = True
+            raise
         finally:
-            # after_run must fire even when training raises (NanGuardHook
-            # action="raise", KeyboardInterrupt, ...): hooks flush files,
-            # close handles, clean timers
+            # after_run must fire even when training raises: hooks flush
+            # files, close handles, clean timers
             self._call_hook("after_run")
 
     def _train_loop(self, data_loader) -> None:

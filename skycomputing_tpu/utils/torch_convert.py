@@ -114,8 +114,155 @@ def convert_torch_checkpoint(
     ]
 
 
+def _inv_linear(tree, prefix: str, out: Dict[str, np.ndarray]) -> None:
+    out[f"{prefix}.weight"] = np.ascontiguousarray(
+        np.asarray(tree["kernel"]).T
+    )
+    if "bias" in tree:
+        out[f"{prefix}.bias"] = np.asarray(tree["bias"])
+
+
+def _inv_layer_norm(tree, prefix: str, out: Dict[str, np.ndarray]) -> None:
+    out[f"{prefix}.weight"] = np.asarray(tree["scale"])
+    out[f"{prefix}.bias"] = np.asarray(tree["bias"])
+
+
+def _inv_embedding(tree, prefix: str, out: Dict[str, np.ndarray]) -> None:
+    out[f"{prefix}.weight"] = np.asarray(tree["embedding"])
+
+
+def layer_to_torch(layer_type: str, params: Any) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`convert_layer`: flax tree -> de-prefixed torch keys."""
+    out: Dict[str, np.ndarray] = {}
+    if layer_type == "BertEmbeddings":
+        _inv_embedding(params["word_embeddings"], "word_embeddings", out)
+        _inv_embedding(params["position_embeddings"], "position_embeddings",
+                       out)
+        _inv_embedding(params["token_type_embeddings"],
+                       "token_type_embeddings", out)
+        _inv_layer_norm(params["LayerNorm"], "LayerNorm", out)
+    elif layer_type == "BertLayer_Head":
+        _inv_linear(params["self"]["query"], "attention.self.query", out)
+        _inv_linear(params["self"]["key"], "attention.self.key", out)
+        _inv_linear(params["self"]["value"], "attention.self.value", out)
+        _inv_linear(params["output"]["dense"], "attention.output.dense", out)
+        _inv_layer_norm(params["output"]["LayerNorm"],
+                        "attention.output.LayerNorm", out)
+    elif layer_type == "BertLayer_Body":
+        _inv_linear(params["dense_act"], "intermediate.dense_act", out)
+    elif layer_type == "BertLayer_Tail":
+        _inv_linear(params["dense"], "output.dense", out)
+        _inv_layer_norm(params["LayerNorm"], "output.LayerNorm", out)
+    elif layer_type == "BertPooler":
+        _inv_linear(params["dense_act"], "dense_act", out)
+    elif layer_type == "BertTailForClassification":
+        _inv_linear(params["classifier"], "classifier", out)
+    else:
+        raise ValueError(f"no conversion rule for layer type {layer_type!r}")
+    return out
+
+
+def to_torch_state_dict(params_list: List[Any], model_cfg: List[Dict]):
+    """Layer-indexed flax params -> reference ``nn.ModuleList`` state dict.
+
+    Exact inverse of :func:`convert_torch_checkpoint` — the returned dict
+    (torch tensors, ``"{idx}.{path}"`` keys) is what the reference's
+    ParameterServer would save for the same model
+    (``scaelum/dynamics/parameter_server.py:29-33``), so weights can move
+    framework -> reference -> framework bit-for-bit.
+    """
+    import torch
+
+    if len(params_list) != len(model_cfg):
+        raise ValueError(
+            f"{len(params_list)} param trees for {len(model_cfg)} layers"
+        )
+    state = {}
+    for idx, (cfg, params) in enumerate(zip(model_cfg, params_list)):
+        for path, arr in layer_to_torch(cfg["layer_type"], params).items():
+            state[f"{idx}.{path}"] = torch.from_numpy(
+                np.ascontiguousarray(np.asarray(arr, dtype=np.float32))
+            )
+    return state
+
+
+def convert_hf_bert_state_dict(
+    state: Dict[str, Any], model_cfg: List[Dict]
+) -> List[Any]:
+    """HuggingFace ``bert-*`` state dict -> layer-indexed params.
+
+    Accepts ``BertModel``/``BertForSequenceClassification`` naming (with or
+    without the ``bert.`` prefix): a user of the reference fine-tuned from
+    released BERT-large wwm weights (``/root/reference/experiment/config.py:22``);
+    this is the equivalent entry point for released checkpoints here.
+    Encoder depth must match ``model_cfg``'s trio count; extra heads (MLM
+    etc.) in the checkpoint are ignored.
+    """
+    sd: Dict[str, np.ndarray] = {}
+    for key, value in state.items():
+        arr = np.asarray(
+            value.detach().cpu().numpy() if hasattr(value, "detach")
+            else value
+        )
+        sd[key[5:] if key.startswith("bert.") else key] = arr
+
+    def sub(prefix: str) -> Dict[str, np.ndarray]:
+        hit = {
+            k[len(prefix):]: v for k, v in sd.items() if k.startswith(prefix)
+        }
+        if not hit:
+            raise KeyError(f"no checkpoint entries under {prefix!r}")
+        return hit
+
+    params: List[Any] = []
+    unit = 0
+    for cfg in model_cfg:
+        lt = cfg["layer_type"]
+        if lt == "BertEmbeddings":
+            params.append(convert_layer(lt, sub("embeddings.")))
+        elif lt == "BertLayer_Head":
+            layer = {
+                f"attention.{k}": v
+                for k, v in sub(f"encoder.layer.{unit}.attention.").items()
+            }
+            params.append(convert_layer(lt, layer))
+        elif lt == "BertLayer_Body":
+            inter = sub(f"encoder.layer.{unit}.intermediate.dense.")
+            layer = {f"intermediate.dense_act.{k}": v
+                     for k, v in inter.items()}
+            params.append(convert_layer(lt, layer))
+        elif lt == "BertLayer_Tail":
+            layer = {
+                f"output.{k}": v
+                for k, v in sub(f"encoder.layer.{unit}.output.").items()
+            }
+            params.append(convert_layer(lt, layer))
+            unit += 1
+        elif lt == "BertPooler":
+            layer = {f"dense_act.{k}": v
+                     for k, v in sub("pooler.dense.").items()}
+            params.append(convert_layer(lt, layer))
+        elif lt == "BertTailForClassification":
+            if any(k.startswith("classifier.") for k in sd):
+                layer = {f"classifier.{k}": v
+                         for k, v in sub("classifier.").items()}
+                params.append(convert_layer(lt, layer))
+            else:
+                raise KeyError(
+                    "checkpoint has no classifier head; fine-tune configs "
+                    "should init it fresh (drop the tail from model_cfg and "
+                    "append a fresh-initialized layer)"
+                )
+        else:
+            raise ValueError(f"no conversion rule for layer type {lt!r}")
+    return params
+
+
 __all__ = [
     "convert_torch_checkpoint",
     "convert_layer",
     "split_modulelist_state_dict",
+    "layer_to_torch",
+    "to_torch_state_dict",
+    "convert_hf_bert_state_dict",
 ]

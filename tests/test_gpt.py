@@ -114,6 +114,50 @@ def test_generate_greedy_recovers_pattern(devices):
                  np.arange(30, dtype=np.int32), 6, 32)
 
 
+def test_generate_cached_matches_full_forward():
+    from skycomputing_tpu.models.gpt import generate, generate_cached
+
+    layer_cfgs, cfg = tiny_gpt()
+    stack = build_layer_stack(layer_cfgs)
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(1, 512, (2, 5)).astype(np.int32)
+    params = stack.init(jax.random.key(7), prompt)
+
+    fwd = jax.jit(lambda ids: stack.apply(params, ids))
+
+    # greedy: token-identical
+    full = generate(fwd, prompt, max_new_tokens=9, context_length=32)
+    cached = generate_cached(stack, params, prompt, max_new_tokens=9,
+                             context_length=32)
+    np.testing.assert_array_equal(full, cached)
+
+    # sampled: same rng split sequence -> same tokens
+    full_s = generate(fwd, prompt, max_new_tokens=9, context_length=32,
+                      temperature=0.8, rng=jax.random.key(11))
+    cached_s = generate_cached(stack, params, prompt, max_new_tokens=9,
+                               context_length=32, temperature=0.8,
+                               rng=jax.random.key(11))
+    np.testing.assert_array_equal(full_s, cached_s)
+
+    # single-new-token edge (scan length 0)
+    full_1 = generate(fwd, prompt, max_new_tokens=1, context_length=32)
+    cached_1 = generate_cached(stack, params, prompt, max_new_tokens=1,
+                               context_length=32)
+    np.testing.assert_array_equal(full_1, cached_1)
+
+    # zero-token edge: both return the prompt unchanged
+    np.testing.assert_array_equal(
+        generate_cached(stack, params, prompt, 0, 32), prompt
+    )
+
+    # the compiled program is cached on the stack, not rebuilt per call
+    assert len(stack._decode_programs) >= 2  # decoder + >=1 program
+    before = dict(stack._decode_programs)
+    generate_cached(stack, params, prompt, max_new_tokens=9,
+                    context_length=32)
+    assert stack._decode_programs == before
+
+
 def test_gpt_profiles_through_model_benchmarker():
     from skycomputing_tpu.dataset import BaseGenerator
     from skycomputing_tpu.dynamics import ModelBenchmarker

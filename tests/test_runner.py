@@ -131,6 +131,28 @@ def test_interrupted_run_still_persists_weights(devices, tmp_path):
     )
 
 
+def test_aborted_run_does_not_save_checkpoint(devices, tmp_path):
+    """A training abort (hook raising mid-run) must NOT persist the live —
+    possibly NaN-poisoned — params as the newest checkpoint."""
+    import os
+
+    model, ps, wm, loader = build_world(devices, seed=5)
+    save_dir = str(tmp_path / "aborted")
+    runner = Runner(model, ps, wm, max_epochs=1, max_iters=8)
+    runner.register_hook(CheckpointHook(save_path=save_dir, save_interval=1))
+
+    class Bomb(Hook):
+        def after_train_iter(self, r):
+            if r.iter >= 2:
+                raise RuntimeError("simulated NaN guard")
+
+    runner.register_hook(Bomb())
+    with pytest.raises(RuntimeError, match="simulated NaN guard"):
+        runner.train(_BatchAdapter(loader))
+    assert runner.aborted is True
+    assert not os.path.exists(save_dir) or os.listdir(save_dir) == []
+
+
 def test_completed_epochs_do_not_double_save(devices, tmp_path):
     """A run whose last epoch checkpointed normally must not also emit an
     iter-tagged file from after_run."""
