@@ -34,6 +34,7 @@ class ParameterServer:
         self._model_config = list(model_config)
         self.stack = build_layer_stack(self._model_config)
         self.params: List[Any] = []
+        self._checkpointer = None  # persistent orbax handle (async saves)
         if init:
             if example_inputs is None:
                 raise ValueError(
@@ -77,21 +78,35 @@ class ParameterServer:
         self.params = list(layers)
 
     # --- orbax checkpoint io (directory-based, async-capable) ---------------
-    def save_orbax(self, ckpt_dir: str) -> None:
+    def save_orbax(self, ckpt_dir: str, block: bool = True) -> None:
         """Save via orbax (the TPU ecosystem's checkpoint layer).
 
         Same layer-indexed layout as the msgpack path, so both formats are
         partition-independent; orbax adds async writes and per-array files
         that scale to sharded multi-host checkpoints.
+
+        ``block=False`` returns as soon as the save is enqueued: orbax's
+        background thread owns durability and training overlaps the write.
+        Safe because the master copy is never mutated in place —
+        ``update_weights`` swaps in fresh arrays, so the captured tree
+        stays frozen.  Call :meth:`wait_for_saves` (or the next ``save``)
+        to join.
         """
         import orbax.checkpoint as ocp
 
+        if self._checkpointer is None:
+            self._checkpointer = ocp.StandardCheckpointer()
         host_params = jax.tree_util.tree_map(np.asarray, self.params)
-        ckptr = ocp.StandardCheckpointer()
-        ckptr.save(
+        self._checkpointer.save(
             os.path.abspath(ckpt_dir), {"layers": host_params}, force=True
         )
-        ckptr.wait_until_finished()
+        if block:
+            self._checkpointer.wait_until_finished()
+
+    def wait_for_saves(self) -> None:
+        """Join any in-flight async orbax save (durability barrier)."""
+        if self._checkpointer is not None:
+            self._checkpointer.wait_until_finished()
 
     def load_orbax(self, ckpt_dir: str) -> None:
         import orbax.checkpoint as ocp

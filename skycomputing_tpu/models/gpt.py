@@ -25,6 +25,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import flax.linen as nn
 
 from ..registry import LAYER
@@ -253,6 +254,77 @@ class GptBlock_Mlp(nn.Module):
 
 
 @LAYER.register_module
+class GptBlock_MoeMlp(nn.Module):
+    """Pre-LN mixture-of-experts MLP half of a transformer block.
+
+    Switch/GShard-style: top-k router, fixed-capacity einsum dispatch
+    (``ops/moe.py``), experts stacked on a leading axis so expert
+    parallelism is a ``P('ep', ...)`` sharding annotation on the expert
+    params.  The load-balance aux loss is sown into the 'intermediates'
+    collection (``aux_loss``); training configs add it to the task loss
+    via ``mutable=['intermediates']``.
+    """
+
+    config: Any
+    num_experts: int = 8
+    top_k: int = 1
+    capacity_factor: float = 1.25
+    deterministic: bool = False
+
+    @nn.compact
+    def __call__(self, hidden):
+        from ..ops.moe import (
+            moe_dispatch_combine,
+            router_probs,
+            top_k_dispatch,
+        )
+
+        cfg = _gcfg(self.config)
+        dtype = jnp.dtype(cfg.dtype)
+        act = ACT2FN[cfg.hidden_act]
+        E, H, I = self.num_experts, cfg.hidden_size, cfg.intermediate_size
+
+        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln_2")(
+            hidden
+        ).astype(dtype)
+        B, L, _ = x.shape
+        tokens = x.reshape(B * L, H)
+        T = B * L
+        capacity = max(1, int(np.ceil(T / E * self.capacity_factor)))
+
+        router = self.param(
+            "router", nn.initializers.normal(cfg.initializer_range), (H, E),
+            jnp.float32,
+        )
+        init = nn.initializers.normal(cfg.initializer_range)
+        w1 = self.param("w1", init, (E, H, I), jnp.float32)
+        b1 = self.param("b1", nn.initializers.zeros, (E, I), jnp.float32)
+        w2 = self.param("w2", init, (E, I, H), jnp.float32)
+        b2 = self.param("b2", nn.initializers.zeros, (E, H), jnp.float32)
+
+        probs = router_probs(tokens, router)
+        dispatch, combine, aux = top_k_dispatch(probs, capacity, self.top_k)
+        self.sow("intermediates", "aux_loss", aux)
+
+        def experts(buf):  # [E, C, H] -> [E, C, H]
+            h = act(
+                jnp.einsum("ech,ehi->eci", buf, w1.astype(dtype))
+                + b1[:, None, :].astype(dtype)
+            )
+            return (
+                jnp.einsum("eci,eih->ech", h, w2.astype(dtype))
+                + b2[:, None, :].astype(dtype)
+            )
+
+        out = moe_dispatch_combine(tokens, dispatch, combine, experts)
+        out = out.reshape(B, L, H).astype(dtype)
+        out = nn.Dropout(cfg.dropout_prob)(
+            out, deterministic=self.deterministic
+        )
+        return hidden + out
+
+
+@LAYER.register_module
 class GptLmHead(nn.Module):
     """Final LayerNorm + vocabulary projection."""
 
@@ -278,17 +350,33 @@ def gpt_layer_configs(
     num_blocks: Optional[int] = None,
     deterministic: bool = False,
     mesh: Any = None,
+    moe_every: int = 0,
+    num_experts: int = 8,
+    moe_top_k: int = 1,
+    moe_capacity_factor: float = 1.25,
 ) -> list:
-    """Full layer-config list: embeddings + blocks x (attn, mlp) + LM head."""
+    """Full layer-config list: embeddings + blocks x (attn, mlp) + LM head.
+
+    ``moe_every=n`` replaces every n-th block's MLP with a
+    :class:`GptBlock_MoeMlp` (GShard-style interleaving; 0 = dense only).
+    """
     cfg = _gcfg(config)
     if num_blocks is None:
         num_blocks = cfg.num_hidden_layers
     blocks = []
-    for _ in range(num_blocks):
+    for b in range(num_blocks):
         blocks.append(
             dict(layer_type="GptBlock_Attn", config=cfg.to_dict(),
                  deterministic=deterministic, mesh=mesh)
         )
+        if moe_every and (b + 1) % moe_every == 0:
+            blocks.append(
+                dict(layer_type="GptBlock_MoeMlp", config=cfg.to_dict(),
+                     num_experts=num_experts, top_k=moe_top_k,
+                     capacity_factor=moe_capacity_factor,
+                     deterministic=deterministic)
+            )
+            continue
         blocks.append(
             dict(layer_type="GptBlock_Mlp", config=cfg.to_dict(),
                  deterministic=deterministic)
@@ -532,6 +620,7 @@ __all__ = [
     "GptEmbeddings",
     "GptBlock_Attn",
     "GptBlock_Mlp",
+    "GptBlock_MoeMlp",
     "GptLmHead",
     "gpt_layer_configs",
     "causal_lm_loss",

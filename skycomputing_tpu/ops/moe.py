@@ -1,0 +1,84 @@
+"""Mixture-of-experts routing and dispatch, TPU-first.
+
+The reference has no MoE (SURVEY.md §2.2); this adds the Switch/GShard
+pattern as a framework capability: a learned router picks top-k experts per
+token, tokens are dispatched into fixed-capacity expert buffers with pure
+einsums (static shapes — no gather/scatter, no data-dependent control
+flow), expert FFNs run vmapped over a stacked expert axis, and outputs are
+combined with the gate weights.  Expert parallelism is nothing but a
+sharding annotation on the expert axis ('ep'): under jit XLA lowers the
+dispatch/combine einsums into all-to-alls across the mesh.
+
+Shapes:  tokens [T, H]; router logits [T, E]; dispatch/combine [T, E, C]
+with capacity C = ceil(T / E * capacity_factor).  Tokens over capacity are
+dropped (their combine weight is zero and the residual path carries them) —
+the standard static-shape trade.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def router_probs(tokens, router_kernel) -> jax.Array:
+    """[T, H] x [H, E] -> float32 routing probabilities [T, E]."""
+    logits = tokens.astype(jnp.float32) @ router_kernel.astype(jnp.float32)
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def top_k_dispatch(
+    probs: jax.Array, capacity: int, top_k: int = 1
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Build static-shape dispatch/combine tensors from router probs.
+
+    Returns (dispatch [T, E, C] bool-ish float, combine [T, E, C] float32,
+    aux_loss scalar).  aux_loss is the Switch load-balance loss
+    (E * sum_e fraction_tokens_e * mean_prob_e), which pushes the router
+    toward uniform expert utilization.
+    """
+    T, E = probs.shape
+    gate_vals, expert_idx = jax.lax.top_k(probs, top_k)  # [T, k]
+
+    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    # priority: tokens keep their order per expert; k-th choices queue
+    # after all (k-1)-th choices so primary routes win capacity
+    for k in range(top_k):
+        onehot = jax.nn.one_hot(expert_idx[:, k], E, dtype=jnp.float32)
+        # position of each token within its expert's buffer (dispatch
+        # counts slots already granted to earlier-priority choices)
+        prior = dispatch.sum(axis=(0, 2)) if k else jnp.zeros((E,))
+        pos = jnp.cumsum(onehot, axis=0) - 1.0 + prior[None, :]
+        keep = (pos < capacity) & (onehot > 0)
+        pos_c = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+        slot = jax.nn.one_hot(pos_c, capacity, dtype=jnp.float32)
+        mask = (keep.astype(jnp.float32) * onehot)[:, :, None] * slot
+        dispatch = dispatch + mask
+        combine = combine + mask * gate_vals[:, k][:, None, None]
+
+    # Switch aux loss over the PRIMARY assignment
+    primary = jax.nn.one_hot(expert_idx[:, 0], E, dtype=jnp.float32)
+    frac_tokens = primary.mean(axis=0)
+    mean_probs = probs.mean(axis=0)
+    aux_loss = E * jnp.sum(frac_tokens * mean_probs)
+    return dispatch, combine, aux_loss
+
+
+def moe_dispatch_combine(tokens, dispatch, combine, expert_fn):
+    """tokens [T, H] -> expert buffers [E, C, H] -> combined [T, H].
+
+    ``expert_fn`` maps [E, C, H] -> [E, C, H'] (vmapped expert compute).
+    Pure einsums: on an 'ep'-sharded expert axis XLA turns these into
+    all-to-all exchanges.
+    """
+    expert_in = jnp.einsum("tec,th->ech", dispatch.astype(tokens.dtype),
+                           tokens)
+    expert_out = expert_fn(expert_in)
+    return jnp.einsum("tec,ech->th", combine.astype(expert_out.dtype),
+                      expert_out)
+
+
+__all__ = ["router_probs", "top_k_dispatch", "moe_dispatch_combine"]

@@ -1,4 +1,5 @@
 from .data_parallel import DataParallelPipeline
+from .expert_parallel import ep_shardings, make_ep_mesh, shard_moe_params
 from .mesh import make_dp_pp_mesh, make_dp_pp_tp_mesh, make_pipeline_mesh
 from .multihost import global_mesh, initialize_from_env, is_coordinator
 from .ring_attention import full_attention_reference, ring_attention
@@ -8,6 +9,7 @@ from .tensor_parallel import (
     tp_shardings,
     tp_train_step_fn,
 )
+from .spmd_gpt import CompiledGptPipeline
 from .ulysses import ulysses_attention
 from .pipeline import (
     PipelineModel,
@@ -18,6 +20,10 @@ from .pipeline import (
 
 __all__ = [
     "DataParallelPipeline",
+    "CompiledGptPipeline",
+    "ep_shardings",
+    "make_ep_mesh",
+    "shard_moe_params",
     "make_dp_pp_mesh",
     "make_dp_pp_tp_mesh",
     "make_pipeline_mesh",

@@ -1,0 +1,56 @@
+"""Expert parallelism: shard MoE expert weights over an 'ep' mesh axis.
+
+With the static einsum dispatch in ``ops/moe.py``, expert parallelism is a
+pure layout choice: stacked expert tensors ([E, ...] leaves of
+``GptBlock_MoeMlp``) get ``P('ep', ...)``, everything else replicates, and
+XLA lowers the dispatch/combine einsums into all-to-all exchanges over the
+axis.  No bespoke communication code — same philosophy as the rest of the
+SPMD surface (SURVEY.md §2.3: collectives come from shardings, not calls).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_EXPERT_LEAVES = {"w1", "b1", "w2", "b2"}
+
+
+def make_ep_mesh(ep: int, devices: Optional[Sequence] = None) -> Mesh:
+    """A 1-D ('ep',) mesh over the first ``ep`` devices."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) < ep:
+        raise ValueError(f"need {ep} devices for ep mesh, have {len(devs)}")
+    return Mesh(np.array(devs[:ep]), axis_names=("ep",))
+
+
+def ep_shardings(params_list: List[Any], mesh: Mesh, axis: str = "ep"):
+    """Same-structure tree of NamedShardings: expert-stacked leaves get
+    ``P(axis)`` on their leading (expert) dim, the rest replicate."""
+
+    def one_layer(layer_params):
+        def assign(path, leaf):
+            keys = [getattr(p, "key", str(p)) for p in path]
+            if keys and keys[-1] in _EXPERT_LEAVES:
+                if np.shape(leaf)[0] % mesh.shape[axis] != 0:
+                    raise ValueError(
+                        f"num_experts {np.shape(leaf)[0]} not divisible by "
+                        f"{axis}={mesh.shape[axis]}"
+                    )
+                return NamedSharding(mesh, P(axis))
+            return NamedSharding(mesh, P())
+
+        return jax.tree_util.tree_map_with_path(assign, layer_params)
+
+    return [one_layer(p) for p in params_list]
+
+
+def shard_moe_params(params_list: List[Any], mesh: Mesh, axis: str = "ep"):
+    """Place a layer-indexed param list on the mesh with expert sharding."""
+    return jax.device_put(params_list, ep_shardings(params_list, mesh, axis))
+
+
+__all__ = ["make_ep_mesh", "ep_shardings", "shard_moe_params"]

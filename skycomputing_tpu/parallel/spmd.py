@@ -301,8 +301,10 @@ class CompiledBertPipeline:
         num_microbatches: Optional[int] = None,
         learning_rate: float = 1e-3,
         virtual_stages: int = 1,
+        optimizer: Optional[optax.GradientTransformation] = None,
+        zero1: bool = False,
     ):
-        self.cfg = BertConfig.from_dict(config)
+        self.cfg = self._parse_config(config)
         self.mesh = mesh
         self.num_stages = int(mesh.shape["pp"])
         # interleaved scheduling (Megatron-style): each device owns
@@ -327,13 +329,40 @@ class CompiledBertPipeline:
         self.units_per_stage = units_per_stage
         self.num_classes = num_classes
         self.num_microbatches = num_microbatches or self.num_stages
-        if self.virtual_stages > 1 and self.num_microbatches > self.num_stages:
+        if (
+            self.virtual_stages > 1
+            and self.num_microbatches > self.num_stages
+            and self.num_microbatches % self.num_stages != 0
+        ):
             raise ValueError(
                 f"interleaved scheduling needs num_microbatches "
-                f"({self.num_microbatches}) <= num_stages ({self.num_stages})"
+                f"({self.num_microbatches}) <= num_stages "
+                f"({self.num_stages}), or a multiple of it (grouped "
+                f"Megatron schedule)"
             )
-        self.optimizer = optax.sgd(learning_rate)
+        self.optimizer = optimizer or optax.sgd(learning_rate)
+        # ZeRO-1: shard optimizer-state tensors (momenta etc.) over the dp
+        # axis instead of replicating them.  Under jit this is nothing but
+        # sharding annotations — XLA derives the reduce-scatter of grads
+        # into state shards and the all-gather of updates by itself.
+        self.zero1 = bool(zero1)
+        if self.zero1 and self.dp == 1:
+            raise ValueError("zero1 requires a 'dp' mesh axis of size > 1")
 
+        self._build_modules(units_per_stage, num_classes)
+
+        self._stage_spec = P("pp", "tp") if self.tp > 1 else P("pp")
+        self._repl_spec = P()
+        self.opt_shardings = None
+        self.param_shardings: Optional[Dict] = None
+        self._train_step = None
+
+    @staticmethod
+    def _parse_config(config):
+        return BertConfig.from_dict(config)
+
+    def _build_modules(self, units_per_stage: int, num_classes: int) -> None:
+        """Model-specific module construction (overridden per family)."""
         cfg_dict = self.cfg.to_dict()
         self.embeddings = BertEmbeddings(cfg_dict, deterministic=True)
         self.stage = EncoderStage(cfg_dict, units_per_stage)
@@ -349,11 +378,6 @@ class CompiledBertPipeline:
             deterministic=True,
             dtype=self.cfg.dtype,
         )
-
-        self._stage_spec = P("pp", "tp") if self.tp > 1 else P("pp")
-        self._repl_spec = P()
-        self.param_shardings: Optional[Dict] = None
-        self._train_step = None
 
     # --- init ----------------------------------------------------------------
     def init(self, rng: jax.Array, input_ids, token_type_ids, attention_mask):
@@ -405,7 +429,42 @@ class CompiledBertPipeline:
     def init_opt_state(self, params):
         # any momentum/trace buffers are shaped like params and inherit
         # their shardings (params are already placed by init())
-        return self.optimizer.init(params)
+        opt_state = self.optimizer.init(params)
+        if not self.zero1:
+            return opt_state
+        self.opt_shardings = jax.tree_util.tree_map(
+            self._zero1_sharding, opt_state
+        )
+        return jax.device_put(opt_state, self.opt_shardings)
+
+    def _zero1_sharding(self, leaf):
+        """dp-shard the largest dp-divisible axis of a state tensor.
+
+        Param-shaped leaves keep their stage ('pp'/'tp') dims on the
+        leading axes and additionally split one weight axis over 'dp';
+        scalars/counters stay replicated.
+        """
+        shape = np.shape(leaf)
+        if len(shape) == 0:
+            return NamedSharding(self.mesh, P())
+        # leading axes belong to the stacked-stage layout when they match
+        stage_axes = 0
+        if shape[0] == self.num_stages * self.virtual_stages:
+            stage_axes = 2 if self.tp > 1 and len(shape) > 1 and (
+                shape[1] == self.tp
+            ) else 1
+        spec = (["pp", "tp"][: stage_axes] + [None] * (len(shape) - stage_axes))
+        best = None
+        for ax in range(len(shape) - 1, stage_axes - 1, -1):
+            if shape[ax] % self.dp == 0 and shape[ax] >= self.dp:
+                best = ax
+                break
+        if best is not None:
+            spec[best] = "dp"
+        elif stage_axes == 0:
+            return NamedSharding(self.mesh, P())  # replicated (embeddings
+            # and heads are small next to the encoder stack)
+        return NamedSharding(self.mesh, P(*spec))
 
     # --- the pipelined encoder ----------------------------------------------
     def _run_ring_schedule(self, body, stage_params, hidden_mb, mask_mb):
@@ -427,6 +486,16 @@ class CompiledBertPipeline:
             check_vma=False,
         )(stage_params, hidden_mb, mask_mb)
         return out[-M:]
+
+    def _select_chunk_params(self, local_stage_params, k_c):
+        """This device's chunk ``k_c`` from its [V, (tp,) ...] local leaves."""
+        tp = self.tp
+
+        def index_chunk(x):
+            x = lax.dynamic_index_in_dim(x, k_c, 0, keepdims=False)
+            return x[0] if tp > 1 else x
+
+        return jax.tree_util.tree_map(index_chunk, local_stage_params)
 
     def _pipelined_encoder(self, stage_params, hidden_mb, mask_mb):
         """shard_map GPipe: [M, mb, L, H] -> [M, mb, L, H]."""
@@ -480,8 +549,13 @@ class CompiledBertPipeline:
         at tick t = m + c; with M <= S each device runs at most one chunk
         per tick, and the uniform neighbor ring delivers every chunk
         transition — including slot boundaries (chunk vS-1 on device S-1
-        feeds chunk vS on device 0).
+        feeds chunk vS on device 0).  For M > S (M a multiple of S) the
+        grouped variant below runs instead.
         """
+        if self.num_microbatches > self.num_stages:
+            return self._interleaved_grouped_encoder(
+                stage_params, hidden_mb, mask_mb
+            )
         S, V, M = self.num_stages, self.virtual_stages, self.num_microbatches
         C = S * V
         T = M + C - 1
@@ -503,13 +577,7 @@ class CompiledBertPipeline:
                 k_c = jnp.clip(k, 0, V - 1)
                 m_c = jnp.clip(m, 0, M - 1)
 
-                def index_chunk(x):
-                    x = lax.dynamic_index_in_dim(x, k_c, 0, keepdims=False)
-                    return x[0] if tp > 1 else x
-
-                params_k = jax.tree_util.tree_map(
-                    index_chunk, local_stage_params
-                )
+                params_k = self._select_chunk_params(local_stage_params, k_c)
                 is_first_chunk = (d == 0) & (k_c == 0)
                 inp = jnp.where(is_first_chunk, hidden_mb[m_c], recv)
                 out, _ = stage_mod.apply(
@@ -527,6 +595,77 @@ class CompiledBertPipeline:
                 tick, (state, outputs), jnp.arange(T)
             )
             return outputs
+
+        return self._run_ring_schedule(body, stage_params, hidden_mb, mask_mb)
+
+    def _interleaved_grouped_encoder(self, stage_params, hidden_mb, mask_mb):
+        """Megatron-style grouped interleaving for M > S, S | M.
+
+        Microbatches run in G = M/S groups of S.  Device d at tick t maps
+        tau = t - d to (group g, slot k, offset i) = (tau // (V*S),
+        (tau mod V*S) // S, tau mod S) and computes chunk c = k*S + d on
+        microbatch m = g*S + i.  Dependency check: chunk c-1 of the same
+        microbatch finishes on device d-1 (same slot) or device S-1 (slot
+        k-1, offset i) exactly one tick earlier, so the uniform neighbor
+        ppermute still delivers every transition on time.  Per-device
+        bubble is (S-1)/V chunk-units vs (S-1) for plain GPipe: total
+        ticks T = M*V + S - 1 of 1/V-sized chunks.
+
+        Completed microbatches surface only at (d = S-1, k = V-1); all
+        other ticks write to a scratch slot M that is sliced away.
+        """
+        S, V, M = self.num_stages, self.virtual_stages, self.num_microbatches
+        if M % S != 0:
+            raise ValueError(
+                f"grouped interleaving needs microbatches ({M}) to be a "
+                f"multiple of num_stages ({S})"
+            )
+        T = M * V + S - 1
+        tp = self.tp
+        stage_mod = self.tp_stage if tp > 1 else self.stage
+
+        def body(local_stage_params, hidden_mb, mask_mb):
+            d = lax.axis_index("pp")
+            fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+
+            state = jnp.zeros_like(hidden_mb[0])
+            # slot M is the scratch target for bubble/non-final writes
+            outputs = jnp.zeros(
+                (M + 1,) + hidden_mb.shape[1:], hidden_mb.dtype
+            )
+
+            def tick(carry, t):
+                state, outputs = carry
+                recv = lax.ppermute(state, "pp", fwd_perm)
+                tau = t - d
+                g = tau // (V * S)  # floor division: negative while filling
+                r = tau - g * (V * S)
+                k = r // S
+                i = r - k * S
+                m = g * S + i
+                active = (tau >= 0) & (m >= 0) & (m < M)
+                k_c = jnp.clip(k, 0, V - 1)
+                m_c = jnp.clip(m, 0, M - 1)
+
+                params_k = self._select_chunk_params(local_stage_params, k_c)
+                is_first_chunk = (d == 0) & (k_c == 0)
+                inp = jnp.where(is_first_chunk & active, hidden_mb[m_c],
+                                recv)
+                out, _ = stage_mod.apply(
+                    {"params": params_k}, inp, mask_mb[m_c]
+                )
+                # only the final chunk's completions are real outputs
+                done = active & (k_c == V - 1)
+                w = jnp.where(done, m_c, M)
+                outputs = lax.dynamic_update_index_in_dim(
+                    outputs, out, w, axis=0
+                )
+                return (out, outputs), None
+
+            (_, outputs), _ = lax.scan(
+                tick, (state, outputs), jnp.arange(T)
+            )
+            return outputs[:M]
 
         return self._run_ring_schedule(body, stage_params, hidden_mb, mask_mb)
 
@@ -576,8 +715,22 @@ class CompiledBertPipeline:
     # --- training ------------------------------------------------------------
     def make_train_step(self):
         """The FULL train step — grad + update — as one jitted program."""
+        jit_kwargs = {}
+        if self.zero1:
+            # pin the updated state to its ZeRO shards (and params to
+            # theirs) so XLA reduce-scatters grads into the state update
+            # instead of re-replicating
+            if self.param_shardings is None or self.opt_shardings is None:
+                raise RuntimeError(
+                    "zero1=True needs init() and init_opt_state() before "
+                    "make_train_step() — the step pins outputs to the "
+                    "shardings those calls compute"
+                )
+            jit_kwargs["out_shardings"] = (
+                self.param_shardings, self.opt_shardings, None
+            )
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        @functools.partial(jax.jit, donate_argnums=(0, 1), **jit_kwargs)
         def train_step(params, opt_state, batch, labels):
             loss, grads = jax.value_and_grad(self.loss)(params, batch, labels)
             updates, opt_state = self.optimizer.update(

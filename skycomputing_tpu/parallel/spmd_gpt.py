@@ -1,0 +1,152 @@
+"""Compiled SPMD pipeline for the GPT family.
+
+Reuses :class:`~.spmd.CompiledBertPipeline`'s ring-schedule machinery (the
+GPipe and interleaved shard_map bodies operate on an opaque ``(hidden,
+side)`` pair) with GPT-specific ends: token embeddings in, LM head out,
+causal-LM loss.  The pipelined stage flows ``(hidden, dummy)`` — the causal
+mask is rebuilt inside each block from shapes, so no side tensor rides the
+ring.
+
+This makes the one-jit engine a two-family surface (the reference's engine
+was BERT-only end to end — ``scaelum/experiment/config.py:26-49``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+import flax.linen as nn
+
+from ..models.gpt import (
+    GptBlock_Attn,
+    GptBlock_Mlp,
+    GptConfig,
+    GptEmbeddings,
+    GptLmHead,
+)
+from ..ops.losses import causal_lm_loss
+from .spmd import CompiledBertPipeline
+
+
+class GptEncoderUnit(nn.Module):
+    """One transformer block (attention + MLP), tuple signature."""
+
+    config: Any
+
+    @nn.compact
+    def __call__(self, hidden, dummy):
+        hidden = GptBlock_Attn(self.config, deterministic=True,
+                               name="attn")(hidden)
+        hidden = GptBlock_Mlp(self.config, deterministic=True,
+                              name="mlp")(hidden)
+        return hidden, dummy
+
+
+class GptEncoderStage(nn.Module):
+    """``units`` rematerialized blocks = one uniform pipeline stage."""
+
+    config: Any
+    units: int
+
+    @nn.compact
+    def __call__(self, hidden, dummy):
+        for u in range(self.units):
+            hidden, dummy = nn.remat(GptEncoderUnit)(
+                self.config, name=f"unit_{u}"
+            )(hidden, dummy)
+        return hidden, dummy
+
+
+class CompiledGptPipeline(CompiledBertPipeline):
+    """GPT causal LM with blocks pipelined across a ('pp',) / ('dp','pp')
+    mesh; inherits the GPipe + interleaved schedules, ZeRO-1, and the
+    jitted train step from the BERT engine."""
+
+    @staticmethod
+    def _parse_config(config):
+        return GptConfig.from_dict(config)
+
+    def _build_modules(self, units_per_stage: int, num_classes: int) -> None:
+        if self.tp > 1:
+            raise NotImplementedError(
+                "tensor parallelism inside the compiled GPT pipeline is "
+                "not wired yet; use the BERT engine or a ('dp','pp') mesh"
+            )
+        cfg_dict = self.cfg.to_dict()
+        self.embeddings = GptEmbeddings(cfg_dict, deterministic=True)
+        self.stage = GptEncoderStage(cfg_dict, units_per_stage)
+        self.tp_stage = None
+        self.lm_head = GptLmHead(cfg_dict, deterministic=True)
+
+    # --- init ----------------------------------------------------------------
+    def init(self, rng: jax.Array, input_ids):
+        from jax.sharding import NamedSharding
+
+        k_embed, k_stage, k_head = jax.random.split(rng, 3)
+        embed_vars = self.embeddings.init({"params": k_embed}, input_ids)
+        hidden = self.embeddings.apply(embed_vars, input_ids)
+        dummy = jnp.zeros((), hidden.dtype)
+
+        def init_one_stage(key):
+            return self.stage.init({"params": key}, hidden, dummy)["params"]
+
+        S, V = self.num_stages, self.virtual_stages
+        chunk_keys = jax.random.split(k_stage, S * V)
+        order = [(p % V) * S + p // V for p in range(S * V)]
+        stages = jax.vmap(init_one_stage)(chunk_keys[jnp.asarray(order)])
+
+        head_vars = self.lm_head.init({"params": k_head}, hidden)
+        params = {
+            "embeddings": embed_vars["params"],
+            "stages": stages,
+            "lm_head": head_vars["params"],
+        }
+        self.param_shardings = {
+            "embeddings": NamedSharding(self.mesh, self._repl_spec),
+            "stages": jax.tree_util.tree_map(
+                lambda _: NamedSharding(self.mesh, self._stage_spec), stages
+            ),
+            "lm_head": NamedSharding(self.mesh, self._repl_spec),
+        }
+        return jax.device_put(params, self.param_shardings)
+
+    # --- full model ----------------------------------------------------------
+    def _logits(self, params, input_ids):
+        M = self.num_microbatches
+        hidden = self.embeddings.apply(
+            {"params": params["embeddings"]}, input_ids
+        )
+        B = hidden.shape[0]
+        if B % M != 0:
+            raise ValueError(f"batch {B} not divisible by microbatches {M}")
+        if (B // M) % self.dp != 0:
+            raise ValueError(
+                f"microbatch size {B // M} not divisible by dp={self.dp}"
+            )
+        hidden_mb = hidden.reshape(M, B // M, *hidden.shape[1:])
+        # the ring schedule threads a per-microbatch side tensor; GPT needs
+        # none, so ride a batch-shaped zero (batch-like so the dp sharding
+        # spec applies to it uniformly)
+        dummy_mb = jnp.zeros((M, B // M), hidden.dtype)
+
+        if self.virtual_stages > 1:
+            encoded = self._interleaved_encoder(
+                params["stages"], hidden_mb, dummy_mb
+            )
+        else:
+            encoded = self._pipelined_encoder(
+                params["stages"], hidden_mb, dummy_mb
+            )
+        encoded = encoded.reshape(B, *encoded.shape[2:])
+        return self.lm_head.apply({"params": params["lm_head"]}, encoded)
+
+    def loss(self, params, batch, labels):
+        (input_ids,) = batch if isinstance(batch, tuple) else (batch,)
+        logits = self._logits(params, input_ids)
+        return causal_lm_loss(logits, labels)
+
+
+__all__ = ["CompiledGptPipeline", "GptEncoderStage", "GptEncoderUnit"]

@@ -31,13 +31,19 @@ class CheckpointHook(Hook):
         save_interval: Optional[int] = None,
         format: str = "msgpack",  # msgpack (single file) | orbax (directory)
         save_training_state: bool = False,
+        async_save: bool = False,
     ):
         if format not in ("msgpack", "orbax"):
             raise ValueError(f"unknown checkpoint format {format!r}")
+        if async_save and format != "orbax":
+            raise ValueError("async_save requires format='orbax'")
         self._load_checkpoint_from = load_checkpoint_from
         self._save_path = save_path
         self._save_interval = save_interval
         self._format = format
+        # async: epoch saves overlap training (orbax background thread);
+        # after_run joins so the process never exits with writes in flight
+        self._async_save = async_save
         # also checkpoint optimizer state + epoch/iter counters for exact
         # resume (params alone restart momentum and the schedule position).
         # Training state is partition-DEPENDENT; restore requires the same
@@ -106,20 +112,25 @@ class CheckpointHook(Hook):
             return
         if getattr(runner, "aborted", False):
             # training raised (NaN guard, interrupt): the live params are
-            # suspect — leave the last good checkpoint as the newest one
+            # suspect — leave the last good checkpoint as the newest one,
+            # but still join any in-flight async write
+            runner.parameter_server.wait_for_saves()
             runner.logger.info(
                 "training aborted; skipping final checkpoint save"
             )
             return
         if runner.iter > self._last_saved_iter:
             self._save(runner, f"iter_{runner.iter}")
+        runner.parameter_server.wait_for_saves()
 
     def _save(self, runner, tag: str) -> None:
         os.makedirs(self._save_path, exist_ok=True)
         runner.model.sync_to_parameter_server()
         if self._format == "orbax":
             path = osp.join(self._save_path, tag)
-            runner.parameter_server.save_orbax(path)
+            runner.parameter_server.save_orbax(
+                path, block=not self._async_save
+            )
         else:
             path = osp.join(self._save_path, f"{tag}.msgpack")
             runner.parameter_server.save_weights_to_file(path)

@@ -37,6 +37,30 @@ def test_long_head_matches_standard_head(devices):
                                rtol=3e-5, atol=3e-6)
 
 
+def test_single_device_flash_default_matches_einsum():
+    """mesh=None: the default path is the flash kernel (interpret mode on
+    CPU) and must match the einsum reference path bit-for-tolerance."""
+    cfg = bert_config("tiny", dtype="float32", hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0,
+                      max_position_embeddings=256)
+    flash = build_layer("LongBertLayer_Head", config=cfg.to_dict(),
+                        deterministic=True)  # use_flash defaults True
+    einsum = build_layer("LongBertLayer_Head", config=cfg.to_dict(),
+                         deterministic=True, use_flash=False)
+    assert flash.use_flash and not einsum.use_flash
+
+    rng = np.random.default_rng(3)
+    hidden = rng.normal(size=(2, 256, 128)).astype(np.float32)
+    mask4 = np.zeros((2, 1, 1, 256), np.float32)
+    mask4[:, :, :, 192:] = -10000.0
+
+    params = flash.init({"params": jax.random.key(0)}, hidden, mask4)
+    out_flash, _ = flash.apply(params, hidden, mask4)
+    out_einsum, _ = einsum.apply(params, hidden, mask4)
+    np.testing.assert_allclose(np.asarray(out_flash), np.asarray(out_einsum),
+                               rtol=3e-5, atol=3e-6)
+
+
 def test_long_bert_full_model_long_sequence(devices):
     """512-token stacked long-BERT classifier forward on the 8-device ring."""
     cfg = bert_config("tiny", dtype="float32", hidden_dropout_prob=0.0,

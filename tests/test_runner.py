@@ -254,6 +254,34 @@ def test_orbax_checkpoint_roundtrip_across_partitions(devices, tmp_path):
     )
 
 
+def test_async_orbax_checkpoint(devices, tmp_path):
+    """async_save=True: saves overlap training, after_run joins the write,
+    and the restored weights match the synchronous path's."""
+    model, ps, wm, loader = build_world(devices, seed=7)
+    runner = Runner(model, ps, wm, max_epochs=2, max_iters=100)
+    save_dir = str(tmp_path / "async_ckpts")
+    runner.register_hook(CheckpointHook(save_path=save_dir, save_interval=1,
+                                        format="orbax", async_save=True))
+    runner.train(list(_BatchAdapter(loader))[:2])
+    # after_run joined the background write: both epochs fully durable
+    ckpt = osp.join(save_dir, "epoch_2")
+    assert osp.isdir(ckpt)
+
+    model2, ps2, wm2, _ = build_world(devices, n_workers=2, seed=8)
+    runner2 = Runner(model2, ps2, wm2, max_epochs=0, max_iters=0)
+    runner2.register_hook(CheckpointHook(load_checkpoint_from=ckpt))
+    runner2.train(_BatchAdapter(loader))
+    batch = next(iter(_BatchAdapter(loader)))
+    np.testing.assert_allclose(
+        np.asarray(model.forward(batch[0])),
+        np.asarray(model2.forward(batch[0])),
+        rtol=2e-4, atol=2e-5,
+    )
+
+    with pytest.raises(ValueError, match="async_save requires"):
+        CheckpointHook(save_path=save_dir, save_interval=1, async_save=True)
+
+
 def test_checkpoint_every_n_epochs_exact(devices, tmp_path):
     """save_interval=2, max_epochs=4 -> epoch_2 and epoch_4, not 1/3."""
     model, ps, wm, loader = build_world(devices)

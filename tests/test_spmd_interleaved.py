@@ -60,9 +60,49 @@ def test_interleaved_trains(world):
     assert losses[-1] < losses[0], losses
 
 
-def test_interleaved_rejects_too_many_microbatches(devices):
+def test_interleaved_rejects_non_multiple_microbatches(devices):
     cfg = bert_config("tiny", dtype="float32")
     mesh = make_pipeline_mesh(4, devices)
+    # M > S is fine when S | M (grouped schedule); 6 = 1.5*S is not
     with pytest.raises(ValueError, match="interleaved"):
         CompiledBertPipeline(cfg, mesh, units_per_stage=1,
-                             num_microbatches=8, virtual_stages=2)
+                             num_microbatches=6, virtual_stages=2)
+
+
+def test_grouped_interleaved_m_gt_s_matches_sequential(devices):
+    """M=8 > S=4 runs the grouped Megatron schedule; same math."""
+    cfg = bert_config("tiny", dtype="float32", hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)
+    mesh = make_pipeline_mesh(4, devices)
+    S, V, M = 4, 2, 8
+    pipe = CompiledBertPipeline(cfg, mesh, units_per_stage=1, num_classes=3,
+                                num_microbatches=M, virtual_stages=V)
+    rng = np.random.default_rng(1)
+    ids = rng.integers(5, 1024, size=(16, 16)).astype(np.int32)
+    types = np.zeros_like(ids)
+    mask = np.ones_like(ids)
+    labels = rng.integers(0, 3, size=(16,)).astype(np.int32)
+    params = pipe.init(jax.random.key(0), ids, types, mask)
+    logits = np.asarray(pipe._logits(params, ids, types, mask))
+
+    hidden, mask4 = pipe.embeddings.apply(
+        {"params": params["embeddings"]}, ids, types, mask
+    )
+    host_stages = jax.tree_util.tree_map(np.asarray, params["stages"])
+    for c in range(S * V):
+        p = (c % S) * V + (c // S)
+        chunk_params = jax.tree_util.tree_map(lambda x: x[p], host_stages)
+        hidden, mask4 = pipe.stage.apply(
+            {"params": chunk_params}, hidden, mask4
+        )
+    pooled = pipe.pooler.apply({"params": params["pooler"]}, hidden, mask4)
+    ref = np.asarray(
+        pipe.classifier.apply({"params": params["classifier"]}, pooled)
+    )
+    np.testing.assert_allclose(logits, ref, rtol=3e-4, atol=3e-5)
+
+    # and the grouped schedule trains end to end
+    opt_state = pipe.init_opt_state(params)
+    p2, o2, loss = pipe.train_step(params, opt_state, (ids, types, mask),
+                                   labels)
+    assert np.isfinite(float(loss))

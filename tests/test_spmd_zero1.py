@@ -1,0 +1,66 @@
+"""ZeRO-1 optimizer-state sharding over the dp axis.
+
+The state shards must (a) actually partition the big momenta over dp —
+smaller per-device bytes than replication — and (b) leave the training
+math untouched: step-for-step parity with the replicated-state pipeline.
+"""
+
+import jax
+import numpy as np
+import optax
+
+from skycomputing_tpu.models import bert_config
+from skycomputing_tpu.parallel import make_dp_pp_mesh
+from skycomputing_tpu.parallel.spmd import CompiledBertPipeline
+
+
+def _world(devices, zero1):
+    cfg = bert_config("tiny", dtype="float32", hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)
+    mesh = make_dp_pp_mesh(2, 4, devices)
+    pipe = CompiledBertPipeline(
+        cfg, mesh, units_per_stage=1, num_microbatches=2,
+        optimizer=optax.adam(1e-3), zero1=zero1,
+    )
+    rng = np.random.default_rng(0)
+    ids = rng.integers(5, 1024, size=(8, 16)).astype(np.int32)
+    batch = (ids, np.zeros_like(ids), np.ones_like(ids))
+    labels = rng.integers(0, 3, size=(8,)).astype(np.int32)
+    params = pipe.init(jax.random.key(0), *batch)
+    opt_state = pipe.init_opt_state(params)
+    return pipe, params, opt_state, batch, labels
+
+
+def test_zero1_shards_state_over_dp(devices):
+    pipe, params, opt_state, *_ = _world(devices, zero1=True)
+    # adam's mu for the encoder stages must carry a 'dp' dim in its spec
+    mu_stage_leaves = jax.tree_util.tree_leaves(opt_state[0].mu["stages"])
+    specs = [leaf.sharding.spec for leaf in mu_stage_leaves]
+    assert any("dp" in [ax for ax in spec if ax] for spec in specs), specs
+    # and per-device bytes actually shrink vs the replicated layout: with
+    # pp=4 and dp=2 a dp-sharded stage leaf holds 1/8 of the stacked tensor
+    for leaf in mu_stage_leaves:
+        if "dp" in [ax for ax in leaf.sharding.spec if ax]:
+            shard_bytes = leaf.addressable_shards[0].data.nbytes
+            assert shard_bytes <= leaf.nbytes // 8, (
+                shard_bytes, leaf.nbytes, leaf.sharding.spec
+            )
+
+
+def test_zero1_matches_replicated_training(devices):
+    pipe_r, params_r, opt_r, batch, labels = _world(devices, zero1=False)
+    pipe_z, params_z, opt_z, _, _ = _world(devices, zero1=True)
+
+    for i in range(3):
+        params_r, opt_r, loss_r = pipe_r.train_step(params_r, opt_r, batch,
+                                                    labels)
+        params_z, opt_z, loss_z = pipe_z.train_step(params_z, opt_z, batch,
+                                                    labels)
+        np.testing.assert_allclose(float(loss_r), float(loss_z), rtol=2e-5)
+
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        ),
+        params_r, params_z,
+    )
