@@ -32,8 +32,9 @@ Env knobs: SKYTPU_BENCH_WORKERS (64), SKYTPU_BENCH_LAYER_NUM (53 trios ->
 the paper's 160-layer scale), SKYTPU_BENCH_PRESET (large),
 SKYTPU_BENCH_BATCH (32), SKYTPU_BENCH_MICROBATCHES (2x workers),
 SKYTPU_BENCH_SLOWDOWN (paper | stimulator), SKYTPU_BENCH_REPEATS (2),
-SKYTPU_BENCH_SEQUENTIAL=1 to score the reference's non-microbatched
-schedule (sum of stage times) instead.
+SKYTPU_BENCH_MEM_MB (default sizes total capacity at 1.5x the model's
+own static memory footprint), SKYTPU_BENCH_SEQUENTIAL=1 to score the
+reference's non-microbatched schedule (sum of stage times) instead.
 """
 
 from __future__ import annotations
@@ -160,9 +161,6 @@ def main() -> int:
     from skycomputing_tpu.stimulator import Stimulator
 
     mem_skew = np.asarray(Stimulator(n_workers).m_slowdown[:n_workers])
-    mem_budget_mb = float(
-        os.getenv("SKYTPU_BENCH_MEM_MB", str(64 * 1024 / n_workers))
-    )
 
     rng = np.random.default_rng(0)
     ids = rng.integers(5, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
@@ -172,6 +170,23 @@ def main() -> int:
     data = (ids, types, mask)
 
     ps = ParameterServer(model_cfg, example_inputs=data, rng=jax.random.key(0))
+
+    # one ModelBenchmarker shared by both allocations (static eval_shape;
+    # config-hash cached) — also sizes the default per-worker memory budget
+    model_bench = ModelBenchmarker(
+        model_cfg,
+        RandomTokenGenerator(batch_size=batch, seq_length=seq,
+                             vocab_size=cfg.vocab_size),
+    )
+    _, layer_mem = model_bench.benchmark()
+    # default budget: total capacity = 1.5x the model's own footprint, so
+    # the instance is feasible at every preset but memory still binds the
+    # allocator (worker capacity_i = budget / mem_skew_i, applied once by
+    # the ProfileSkew hook below)
+    default_budget = 1.5 * float(np.sum(layer_mem)) / float(
+        np.sum(1.0 / mem_skew)
+    )
+    mem_budget_mb = float(os.getenv("SKYTPU_BENCH_MEM_MB", default_budget))
 
     class ProfileSkew:
         """Stimulator-compatible hook feeding the chosen slowdown draw."""
@@ -190,9 +205,11 @@ def main() -> int:
                 dict(
                     name=f"node-{i}",
                     device_config=dict(device_index=i % len(devices)),
+                    # raw budget: the DeviceBenchmarker divides by the
+                    # ProfileSkew memory_slowdown (skew applied exactly once)
                     extra_config=dict(
                         slowdown=float(slowdowns[i]),
-                        mem_limit=mem_budget_mb / float(mem_skew[i]),
+                        mem_limit=mem_budget_mb,
                     ),
                 )
                 for i in range(n_workers)
@@ -201,11 +218,7 @@ def main() -> int:
         allocator = Allocator(
             model_cfg,
             wm,
-            ModelBenchmarker(
-                model_cfg,
-                RandomTokenGenerator(batch_size=batch, seq_length=seq,
-                                     vocab_size=cfg.vocab_size),
-            ),
+            model_bench,
             DeviceBenchmarker(
                 wm,
                 RandomTensorGenerator(size=(256, 1024)),

@@ -270,6 +270,10 @@ class GptBlock_MoeMlp(nn.Module):
     top_k: int = 1
     capacity_factor: float = 1.25
     deterministic: bool = False
+    # return (hidden, aux) instead of sowing — for callers whose tracing
+    # context cannot harvest mutable collections (scan/shard_map pipeline
+    # stages, skycomputing_tpu/parallel/spmd_gpt.py)
+    return_aux: bool = False
 
     @nn.compact
     def __call__(self, hidden):
@@ -321,6 +325,8 @@ class GptBlock_MoeMlp(nn.Module):
         out = nn.Dropout(cfg.dropout_prob)(
             out, deterministic=self.deterministic
         )
+        if self.return_aux:
+            return hidden + out, aux
         return hidden + out
 
 

@@ -1,6 +1,7 @@
 from .data_parallel import DataParallelPipeline
 from .expert_parallel import ep_shardings, make_ep_mesh, shard_moe_params
 from .mesh import make_dp_pp_mesh, make_dp_pp_tp_mesh, make_pipeline_mesh
+from .heartbeat import PeerHeartbeat
 from .multihost import global_mesh, initialize_from_env, is_coordinator
 from .ring_attention import full_attention_reference, ring_attention
 from .tensor_parallel import (
@@ -32,6 +33,7 @@ __all__ = [
     "StageRuntime",
     "clear_program_cache",
     "global_mesh",
+    "PeerHeartbeat",
     "initialize_from_env",
     "is_coordinator",
     "ring_attention",

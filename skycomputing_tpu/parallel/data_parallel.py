@@ -78,6 +78,7 @@ class DataParallelPipeline:
             for r in range(num_replicas)
         ]
         self.stats = PipelineStats()
+        self._step_call_count = 0
 
     def _split_replicas(self, tree):
         return _split_microbatches(tree, self.num_replicas, what="replicas")
@@ -89,7 +90,11 @@ class DataParallelPipeline:
         from ..builder import as_tuple
 
         if rng is None:
-            rng = jax.random.key(int(time.time_ns() % (2**31)))
+            # deterministic default (mirrors PipelineModel): fold a per-call
+            # counter into a fixed base key so identically-seeded runs
+            # replay identically
+            rng = jax.random.fold_in(jax.random.key(1), self._step_call_count)
+            self._step_call_count += 1
         R = self.num_replicas
         data_shards = self._split_replicas(as_tuple(data))
         label_shards = self._split_replicas(labels)

@@ -1,0 +1,129 @@
+"""Peer liveness detection for the multi-host world.
+
+The reference detects trainer failure with RPC timeouts around
+``dist.rpc`` calls; under a JAX multi-process world there is no RPC — the
+failure mode is a *collective that never completes* because a peer died or
+wedged.  The detector is therefore a tiny global all-reduce ("beat")
+issued at a safe synchronization point (every process beats at the same
+iteration), watched by a timer thread that never touches the device: if
+the beat neither completes nor raises within ``timeout_s``, the peer
+world is declared failed.
+
+Design notes (TPU/XLA):
+- the beat is a jitted replicated-sum over every device in the world —
+  one scalar per device, so it costs one DCN/ICI latency, not bandwidth;
+- the watchdog only OBSERVES (logs + optional abort): a wedged XLA
+  collective cannot be cancelled from Python, so recovery is process
+  restart, exactly like the reference's torch RPC world after a peer
+  loss;
+- a raised exception from the runtime (the coordination service notices
+  dead clients) counts as detection too, not a crash.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..utils.logger import Logger
+
+
+class PeerHeartbeat:
+    """Detect dead/stalled peers with timed global all-reduces.
+
+    ``beat()`` is collective: EVERY process in the world must call it at
+    the same logical point (e.g. the same training iteration), or the
+    beat itself becomes the stall it is trying to detect.
+    """
+
+    def __init__(
+        self,
+        timeout_s: float = 60.0,
+        on_failure: Optional[Callable[[str], None]] = None,
+        abort_on_failure: bool = False,
+        abort_exit_code: int = 17,
+        logger: Optional[Logger] = None,
+    ):
+        self.timeout_s = float(timeout_s)
+        self.failed = False
+        self.last_beat_s: Optional[float] = None
+        self.beats = 0
+        self._logger = logger or Logger()
+        self._on_failure = on_failure
+        self._abort = bool(abort_on_failure)
+        self._abort_exit_code = int(abort_exit_code)
+        self._beat_fn = None
+
+    def _build(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .multihost import global_mesh
+
+        world = len(jax.devices())
+        mesh = global_mesh(("all",), (world,))
+        ones = jax.make_array_from_callback(
+            (world,), NamedSharding(mesh, P("all")),
+            lambda idx: np.ones((1,), np.float32),
+        )
+        fn = jax.jit(
+            lambda x: x.sum(), out_shardings=NamedSharding(mesh, P())
+        )
+        self._expected = float(world)
+        self._ones = ones
+        self._beat_fn = fn
+        # warm the executable so the first timed beat measures the
+        # collective, not compilation
+        jax.block_until_ready(fn(ones))
+
+    def _fail(self, reason: str) -> None:
+        self.failed = True
+        self._logger.info(f"peer heartbeat FAILED: {reason}")
+        if self._on_failure is not None:
+            self._on_failure(reason)
+        if self._abort:
+            # a wedged collective cannot be cancelled; die so the
+            # scheduler can restart the world
+            os._exit(self._abort_exit_code)
+
+    def beat(self) -> bool:
+        """One timed global all-reduce; returns True when peers are live."""
+        if self._beat_fn is None:
+            self._build()
+
+        timer = threading.Timer(
+            self.timeout_s,
+            lambda: self._fail(
+                f"collective did not complete within {self.timeout_s}s "
+                f"(a peer process is dead or wedged)"
+            ),
+        )
+        timer.daemon = True
+        start = time.perf_counter()
+        timer.start()
+        try:
+            total = float(jax.block_until_ready(self._beat_fn(self._ones)))
+        except Exception as exc:  # runtime noticed a dead peer
+            timer.cancel()
+            self._fail(f"collective raised: {exc!r}")
+            return False
+        timer.cancel()
+        self.last_beat_s = time.perf_counter() - start
+        self.beats += 1
+        if self.failed:
+            return False  # the timer fired before completion
+        if total != self._expected:
+            self._fail(
+                f"beat sum {total} != world size {self._expected} "
+                f"(device dropped mid-collective?)"
+            )
+            return False
+        return True
+
+
+__all__ = ["PeerHeartbeat"]

@@ -285,6 +285,7 @@ class PipelineModel:
         self.stats = PipelineStats()
         self._train = True
         self._fwd_call_count = 0
+        self._grad_call_count = 0
 
         self.stages: List[StageRuntime] = []
         self._build_stages()
@@ -450,7 +451,11 @@ class PipelineModel:
         block: bool = True,
     ):
         if rng is None:
-            rng = jax.random.key(int(time.time_ns() % (2**31)))
+            # deterministic default: fold a per-call counter into a fixed
+            # base key so identically-seeded runs replay identically (a
+            # wall-clock seed would differ run to run)
+            rng = jax.random.fold_in(jax.random.key(1), self._grad_call_count)
+            self._grad_call_count += 1
         M = self.num_microbatches
         micro_data = _split_microbatches(as_tuple(data), M)
         micro_labels = _split_microbatches(labels, M)
@@ -518,7 +523,8 @@ class PipelineModel:
         are bounded by the pipeline depth rather than M.
         """
         if rng is None:
-            rng = jax.random.key(int(time.time_ns() % (2**31)))
+            rng = jax.random.fold_in(jax.random.key(1), self._grad_call_count)
+            self._grad_call_count += 1
         M = self.num_microbatches
         S = len(self.stages)
         micro_data = _split_microbatches(as_tuple(data), M)

@@ -242,24 +242,33 @@ def _leaf_role(path) -> Tuple[str, str]:
     return keys[-2], keys[-1]  # (module, param) e.g. ('query', 'kernel')
 
 
-def split_stage_params_for_tp(stages, tp: int):
+# module names whose Dense is column- vs row-parallel, per model family
+BERT_TP_COL = ("query", "key", "value", "dense_act")
+BERT_TP_ROW = ("dense",)
+
+
+def split_stage_params_for_tp(stages, tp: int,
+                              col_modules=BERT_TP_COL,
+                              row_modules=BERT_TP_ROW):
     """[P, ...full...] stacked stage params -> [P, tp, ...local...].
 
     Column-parallel leaves (q/k/v, FFN up) slice output features; row-
     parallel kernels (attention out, FFN down) slice input features; biases
     of row-parallel layers and LayerNorms replicate across tp.
+    ``col_modules``/``row_modules`` name the Dense submodules playing each
+    role (defaults match the BERT encoder; the GPT engine passes its own).
     """
 
     def split(path, leaf):
         module, param = _leaf_role(path)
         P_ = leaf.shape[0]
-        if module in ("query", "key", "value", "dense_act"):
+        if module in col_modules:
             if param == "kernel":
                 i, o = leaf.shape[1:]
                 return leaf.reshape(P_, i, tp, o // tp).transpose(0, 2, 1, 3)
             o = leaf.shape[1]
             return leaf.reshape(P_, tp, o // tp)
-        if module == "dense" and param == "kernel":
+        if module in row_modules and param == "kernel":
             i, o = leaf.shape[1:]
             return leaf.reshape(P_, tp, i // tp, o)
         # row-parallel bias, LayerNorm scale/bias: replicate
@@ -270,18 +279,48 @@ def split_stage_params_for_tp(stages, tp: int):
     return jax.tree_util.tree_map_with_path(split, stages)
 
 
-def merge_stage_params_from_tp(stages_tp):
+@jax.custom_vjp
+def _psum_grad_tp(x):
+    """Identity whose cotangent is ``psum``-med over the 'tp' axis.
+
+    Replicated param leaves (LayerNorms, row-parallel biases) get their
+    copies stacked on a tp axis of the global array, so the spec-driven
+    shard_map transpose hands each device only its *partial* cotangent
+    (the partials sum to the true one; sharded kernels are exact because
+    their reverse path crosses the forward ``psum``, whose transpose is a
+    ``psum`` under ``check_vma=False``).  Wrapping the forward use of a
+    replicated leaf in this identity makes each copy's gradient the full
+    cross-tp sum, keeping copies equal and equal to the unsharded model's
+    gradient.
+    """
+    return x
+
+
+def _psum_grad_tp_fwd(x):
+    return x, None
+
+
+def _psum_grad_tp_bwd(_, g):
+    return (lax.psum(g, "tp"),)
+
+
+_psum_grad_tp.defvjp(_psum_grad_tp_fwd, _psum_grad_tp_bwd)
+
+
+def merge_stage_params_from_tp(stages_tp,
+                               col_modules=BERT_TP_COL,
+                               row_modules=BERT_TP_ROW):
     """Inverse of :func:`split_stage_params_for_tp`."""
 
     def merge(path, leaf):
         module, param = _leaf_role(path)
         P_, tp = leaf.shape[:2]
-        if module in ("query", "key", "value", "dense_act"):
+        if module in col_modules:
             if param == "kernel":
                 i, o = leaf.shape[2:]
                 return leaf.transpose(0, 2, 1, 3).reshape(P_, i, tp * o)
             return leaf.reshape(P_, -1)
-        if module == "dense" and param == "kernel":
+        if module in row_modules and param == "kernel":
             i, o = leaf.shape[2:]
             return leaf.reshape(P_, tp * i, o)
         return leaf[:, 0]
@@ -291,6 +330,12 @@ def merge_stage_params_from_tp(stages_tp):
 
 class CompiledBertPipeline:
     """BERT classifier with the encoder pipelined across a ('pp',) mesh."""
+
+    # Dense submodule names by Megatron role (overridden per model family);
+    # used both to split full weights into tp shards and to pick which
+    # leaves need the replicated-gradient guard in the stage body
+    tp_col_modules = BERT_TP_COL
+    tp_row_modules = BERT_TP_ROW
 
     def __init__(
         self,
@@ -402,7 +447,9 @@ class CompiledBertPipeline:
         stages = jax.vmap(init_one_stage)(chunk_keys[jnp.asarray(order)])
         if self.tp > 1:
             # full weights -> per-device Megatron shards on a new axis 1
-            stages = split_stage_params_for_tp(stages, self.tp)
+            stages = split_stage_params_for_tp(
+                stages, self.tp, self.tp_col_modules, self.tp_row_modules
+            )
 
         pooler_vars = self.pooler.init({"params": k_pool}, hidden, mask4)
         pooled = self.pooler.apply(pooler_vars, hidden, mask4)
@@ -466,6 +513,11 @@ class CompiledBertPipeline:
             # and heads are small next to the encoder stack)
         return NamedSharding(self.mesh, P(*spec))
 
+    # side_outputs=True (set by engines whose stages accumulate a scalar
+    # into the ring's side tensor, e.g. MoE aux loss): the schedule returns
+    # (hidden_out, side_out) instead of hidden_out alone
+    side_outputs = False
+
     # --- the pipelined encoder ----------------------------------------------
     def _run_ring_schedule(self, body, stage_params, hidden_mb, mask_mb):
         """Shared shard_map scaffolding for both pipeline schedules.
@@ -473,19 +525,37 @@ class CompiledBertPipeline:
         ``body(local_stage_params, hidden_mb, mask_mb) -> [M, ...]`` runs
         per device; activations keep their optional dp sharding, outputs
         stack per-stage buffers along axis 0 and only the last device's
-        block (the final stage/chunk) is meaningful.
+        block (the final stage/chunk) is meaningful.  With
+        ``side_outputs`` the body returns a (hidden, side) buffer pair.
         """
         M = self.num_microbatches
         act_spec = P(None, "dp") if self.dp > 1 else P()
         out_spec = P("pp", "dp") if self.dp > 1 else P("pp")
+        out_specs = (out_spec, out_spec) if self.side_outputs else out_spec
         out = jax.shard_map(
             body,
             mesh=self.mesh,
             in_specs=(self._stage_spec, act_spec, act_spec),
-            out_specs=out_spec,
+            out_specs=out_specs,
             check_vma=False,
         )(stage_params, hidden_mb, mask_mb)
+        if self.side_outputs:
+            return out[0][-M:], out[1][-M:]
         return out[-M:]
+
+    def _guard_tp_replicated(self, local_stage_params):
+        """Wrap tp-replicated leaves so their gradient sums across tp."""
+        if self.tp == 1:
+            return local_stage_params
+        col, row = self.tp_col_modules, self.tp_row_modules
+
+        def guard(path, leaf):
+            module, param = _leaf_role(path)
+            if module in col or (module in row and param == "kernel"):
+                return leaf  # genuinely sharded: transpose is exact
+            return _psum_grad_tp(leaf)
+
+        return jax.tree_util.tree_map_with_path(guard, local_stage_params)
 
     def _select_chunk_params(self, local_stage_params, k_c):
         """This device's chunk ``k_c`` from its [V, (tp,) ...] local leaves."""
@@ -511,8 +581,36 @@ class CompiledBertPipeline:
                 (lambda x: x[0, 0]) if tp > 1 else (lambda x: x[0]),
                 local_stage_params,
             )
+            params = self._guard_tp_replicated(params)
             idx = lax.axis_index("pp")
             fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+
+            if self.side_outputs:
+                # the side is a per-microbatch accumulator (e.g. MoE aux
+                # loss): it travels WITH the microbatch around the ring
+                # instead of being re-fed per stage like the BERT mask
+                state = (jnp.zeros_like(hidden_mb[0]),
+                         jnp.zeros_like(mask_mb[0]))
+                outputs = (jnp.zeros_like(hidden_mb),
+                           jnp.zeros_like(mask_mb))
+
+                def tick_side(carry, t):
+                    (st_h, st_s), (out_h, out_s) = carry
+                    recv_h = lax.ppermute(st_h, "pp", fwd_perm)
+                    recv_s = lax.ppermute(st_s, "pp", fwd_perm)
+                    feed = jnp.clip(t, 0, M - 1)
+                    inp_h = jnp.where(idx == 0, hidden_mb[feed], recv_h)
+                    inp_s = jnp.where(idx == 0, mask_mb[feed], recv_s)
+                    h, s = stage_mod.apply({"params": params}, inp_h, inp_s)
+                    w = jnp.clip(t - (S - 1), 0, M - 1)
+                    out_h = lax.dynamic_update_index_in_dim(out_h, h, w, 0)
+                    out_s = lax.dynamic_update_index_in_dim(out_s, s, w, 0)
+                    return ((h, s), (out_h, out_s)), None
+
+                (_, outputs), _ = lax.scan(
+                    tick_side, (state, outputs), jnp.arange(M + S - 1)
+                )
+                return outputs
 
             state = jnp.zeros_like(hidden_mb[0])
             outputs = jnp.zeros_like(hidden_mb)
@@ -552,6 +650,11 @@ class CompiledBertPipeline:
         feeds chunk vS on device 0).  For M > S (M a multiple of S) the
         grouped variant below runs instead.
         """
+        if self.side_outputs:
+            raise NotImplementedError(
+                "side-accumulating stages (MoE aux) are only wired into "
+                "the plain GPipe schedule; use virtual_stages=1"
+            )
         if self.num_microbatches > self.num_stages:
             return self._interleaved_grouped_encoder(
                 stage_params, hidden_mb, mask_mb
@@ -563,6 +666,7 @@ class CompiledBertPipeline:
         stage_mod = self.tp_stage if tp > 1 else self.stage
 
         def body(local_stage_params, hidden_mb, mask_mb):
+            local_stage_params = self._guard_tp_replicated(local_stage_params)
             d = lax.axis_index("pp")
             fwd_perm = [(i, (i + 1) % S) for i in range(S)]
 
@@ -625,6 +729,7 @@ class CompiledBertPipeline:
         stage_mod = self.tp_stage if tp > 1 else self.stage
 
         def body(local_stage_params, hidden_mb, mask_mb):
+            local_stage_params = self._guard_tp_replicated(local_stage_params)
             d = lax.axis_index("pp")
             fwd_perm = [(i, (i + 1) % S) for i in range(S)]
 

@@ -3,9 +3,10 @@
 Reuses :class:`~.spmd.CompiledBertPipeline`'s ring-schedule machinery (the
 GPipe and interleaved shard_map bodies operate on an opaque ``(hidden,
 side)`` pair) with GPT-specific ends: token embeddings in, LM head out,
-causal-LM loss.  The pipelined stage flows ``(hidden, dummy)`` — the causal
-mask is rebuilt inside each block from shapes, so no side tensor rides the
-ring.
+causal-LM loss.  The pipelined stage flows ``(hidden, side)`` — the causal
+mask is rebuilt inside each block from shapes, so the side tensor is a
+zero placeholder for dense stages, and the Switch load-balance aux-loss
+accumulator for MoE stages (``GptMoeEncoderStage`` + ``side_outputs``).
 
 This makes the one-jit engine a two-family surface (the reference's engine
 was BERT-only end to end — ``scaelum/experiment/config.py:26-49``).
@@ -21,14 +22,22 @@ import optax
 import flax.linen as nn
 
 from ..models.gpt import (
+    ACT2FN,
     GptBlock_Attn,
     GptBlock_Mlp,
+    GptBlock_MoeMlp,
     GptConfig,
     GptEmbeddings,
     GptLmHead,
 )
 from ..ops.losses import causal_lm_loss
-from .spmd import CompiledBertPipeline
+from .spmd import CompiledBertPipeline, _TpDense, split_stage_params_for_tp
+
+# GPT Dense submodules by Megatron role: q/k/v and the FFN up-projection are
+# column-parallel; both attention-out and FFN-down share the name ``c_proj``
+# and are row-parallel (psum)
+GPT_TP_COL = ("q_proj", "k_proj", "v_proj", "c_fc")
+GPT_TP_ROW = ("c_proj",)
 
 
 class GptEncoderUnit(nn.Module):
@@ -60,25 +69,211 @@ class GptEncoderStage(nn.Module):
         return hidden, dummy
 
 
+class GptMoeEncoderStage(nn.Module):
+    """``units`` blocks where every ``moe_every``-th MLP is a Switch MoE.
+
+    The MoE load-balance aux loss cannot be sown through ``lax.scan`` +
+    ``shard_map``, so each MoE block ADDS its aux scalar onto the ring's
+    side tensor (shape [mb]); the engine reads it back from the final
+    stage's side output.  Param tree mirrors the monolithic
+    :class:`~..models.gpt.GptBlock_MoeMlp` (``router``/``w1``..``b2``
+    under ``unit_u/mlp``) so checkpoints port between the two paths.
+    """
+
+    config: Any
+    units: int
+    moe_every: int
+    num_experts: int = 8
+    top_k: int = 1
+    capacity_factor: float = 1.25
+
+    @nn.compact
+    def __call__(self, hidden, side):
+        # every stage runs the SAME module (stage params stack on one
+        # leading axis), so the MoE pattern must repeat per stage; with
+        # moe_every | units the stage-local placement (u+1) % moe_every
+        # coincides exactly with the monolithic model's global placement
+        # (b+1) % moe_every of models/gpt.py::gpt_layer_configs
+        if self.moe_every <= 0 or self.units % self.moe_every:
+            raise ValueError(
+                f"moe_every ({self.moe_every}) must divide units_per_stage "
+                f"({self.units}) so the per-stage MoE pattern matches the "
+                f"monolithic block placement"
+            )
+        outer = self
+
+        class Unit(nn.Module):
+            is_moe: bool
+
+            @nn.compact
+            def __call__(sf, h, s):
+                h = GptBlock_Attn(outer.config, deterministic=True,
+                                  name="attn")(h)
+                if sf.is_moe:
+                    h, aux = GptBlock_MoeMlp(
+                        outer.config, num_experts=outer.num_experts,
+                        top_k=outer.top_k,
+                        capacity_factor=outer.capacity_factor,
+                        deterministic=True, return_aux=True, name="mlp",
+                    )(h)
+                    s = s + aux.astype(s.dtype)
+                else:
+                    h = GptBlock_Mlp(outer.config, deterministic=True,
+                                     name="mlp")(h)
+                return h, s
+
+        for u in range(self.units):
+            is_moe = (u + 1) % self.moe_every == 0
+            hidden, side = nn.remat(Unit)(is_moe, name=f"unit_{u}")(
+                hidden, side
+            )
+        return hidden, side
+
+
+class TpGptUnit(nn.Module):
+    """Megatron-style tensor-parallel GPT block for the pipeline body.
+
+    q/k/v are column-parallel (heads split across tp), the attention output
+    projection and the FFN down-projection are row-parallel with a ``psum``;
+    LayerNorms and residuals are replicated.  The param tree mirrors
+    :class:`GptEncoderUnit` (``attn/q_proj`` etc.) with tp-local leaf
+    shapes, so full weights split by pure reshape
+    (:func:`split_stage_params_for_tp` with the GPT role sets).
+    Deterministic only (the compiled pipeline body never applies dropout).
+    """
+
+    config: Any
+    tp: int
+    axis_name: str = "tp"
+
+    @nn.compact
+    def __call__(self, hidden, dummy):
+        cfg = GptConfig.from_dict(self.config)
+        dtype = jnp.dtype(cfg.dtype)
+        if (
+            cfg.hidden_size % self.tp
+            or cfg.num_attention_heads % self.tp
+            or cfg.intermediate_size % self.tp
+        ):
+            raise ValueError(
+                f"hidden/heads/intermediate "
+                f"({cfg.hidden_size}/{cfg.num_attention_heads}/"
+                f"{cfg.intermediate_size}) must all be divisible by "
+                f"tp={self.tp}"
+            )
+        n_heads = cfg.num_attention_heads // self.tp
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        h_local = cfg.hidden_size // self.tp
+        i_local = cfg.intermediate_size // self.tp
+        tp_axis = self.axis_name
+
+        class Attn(nn.Module):
+            @nn.compact
+            def __call__(sf, hidden):
+                x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32,
+                                 name="ln_1")(hidden).astype(dtype)
+                mk = lambda nm: _TpDense(h_local, dtype, "col", tp_axis,
+                                         name=nm)
+                split = lambda t: t.reshape(
+                    t.shape[0], t.shape[1], n_heads, head_dim
+                )
+                q = split(mk("q_proj")(x))
+                k = split(mk("k_proj")(x))
+                v = split(mk("v_proj")(x))
+                scores = jnp.einsum("blhd,bmhd->bhlm", q, k) / jnp.sqrt(
+                    jnp.asarray(head_dim, dtype)
+                )
+                L = q.shape[1]
+                causal = jnp.tril(jnp.ones((L, L), bool))
+                scores = jnp.where(causal[None, None], scores, -jnp.inf)
+                probs = jax.nn.softmax(
+                    scores.astype(jnp.float32), axis=-1
+                ).astype(dtype)
+                ctx = jnp.einsum("bhlm,bmhd->blhd", probs, v)
+                ctx = ctx.reshape(ctx.shape[0], ctx.shape[1], h_local)
+                out = _TpDense(cfg.hidden_size, dtype, "row", tp_axis,
+                               name="c_proj")(ctx)
+                return hidden + out
+
+        class Mlp(nn.Module):
+            @nn.compact
+            def __call__(sf, hidden):
+                act = ACT2FN[cfg.hidden_act]
+                x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32,
+                                 name="ln_2")(hidden).astype(dtype)
+                x = act(_TpDense(i_local, dtype, "col", tp_axis,
+                                 name="c_fc")(x))
+                x = _TpDense(cfg.hidden_size, dtype, "row", tp_axis,
+                             name="c_proj")(x)
+                return hidden + x
+
+        hidden = Attn(name="attn")(hidden)
+        hidden = Mlp(name="mlp")(hidden)
+        return hidden, dummy
+
+
+class TpGptStage(nn.Module):
+    """``units`` tensor-parallel GPT blocks; remat like GptEncoderStage."""
+
+    config: Any
+    units: int
+    tp: int
+    axis_name: str = "tp"
+
+    @nn.compact
+    def __call__(self, hidden, dummy):
+        for u in range(self.units):
+            hidden, dummy = nn.remat(TpGptUnit)(
+                self.config, self.tp, self.axis_name, name=f"unit_{u}"
+            )(hidden, dummy)
+        return hidden, dummy
+
+
 class CompiledGptPipeline(CompiledBertPipeline):
     """GPT causal LM with blocks pipelined across a ('pp',) / ('dp','pp')
-    mesh; inherits the GPipe + interleaved schedules, ZeRO-1, and the
-    jitted train step from the BERT engine."""
+    / ('dp','pp','tp') mesh; inherits the GPipe + interleaved schedules,
+    tensor parallelism, ZeRO-1, and the jitted train step from the BERT
+    engine."""
+
+    tp_col_modules = GPT_TP_COL
+    tp_row_modules = GPT_TP_ROW
+
+    def __init__(self, config, mesh, units_per_stage, *args,
+                 moe_every: int = 0, num_experts: int = 8,
+                 moe_top_k: int = 1, moe_capacity_factor: float = 1.25,
+                 moe_aux_coef: float = 0.01, **kwargs):
+        # consumed by _build_modules, which the base ctor calls
+        self.moe_every = int(moe_every)
+        self.num_experts = int(num_experts)
+        self.moe_top_k = int(moe_top_k)
+        self.moe_capacity_factor = float(moe_capacity_factor)
+        self.moe_aux_coef = float(moe_aux_coef)
+        super().__init__(config, mesh, units_per_stage, *args, **kwargs)
 
     @staticmethod
     def _parse_config(config):
         return GptConfig.from_dict(config)
 
     def _build_modules(self, units_per_stage: int, num_classes: int) -> None:
-        if self.tp > 1:
-            raise NotImplementedError(
-                "tensor parallelism inside the compiled GPT pipeline is "
-                "not wired yet; use the BERT engine or a ('dp','pp') mesh"
-            )
         cfg_dict = self.cfg.to_dict()
         self.embeddings = GptEmbeddings(cfg_dict, deterministic=True)
-        self.stage = GptEncoderStage(cfg_dict, units_per_stage)
-        self.tp_stage = None
+        if self.moe_every:
+            if self.tp > 1 or self.virtual_stages > 1:
+                raise NotImplementedError(
+                    "MoE stages compose with the plain GPipe schedule "
+                    "(virtual_stages=1) without tensor parallelism"
+                )
+            self.stage = GptMoeEncoderStage(
+                cfg_dict, units_per_stage, self.moe_every,
+                self.num_experts, self.moe_top_k, self.moe_capacity_factor,
+            )
+            self.side_outputs = True
+        else:
+            self.stage = GptEncoderStage(cfg_dict, units_per_stage)
+        self.tp_stage = (
+            TpGptStage(cfg_dict, units_per_stage, self.tp)
+            if self.tp > 1 else None
+        )
         self.lm_head = GptLmHead(cfg_dict, deterministic=True)
 
     # --- init ----------------------------------------------------------------
@@ -97,6 +292,10 @@ class CompiledGptPipeline(CompiledBertPipeline):
         chunk_keys = jax.random.split(k_stage, S * V)
         order = [(p % V) * S + p // V for p in range(S * V)]
         stages = jax.vmap(init_one_stage)(chunk_keys[jnp.asarray(order)])
+        if self.tp > 1:
+            stages = split_stage_params_for_tp(
+                stages, self.tp, self.tp_col_modules, self.tp_row_modules
+            )
 
         head_vars = self.lm_head.init({"params": k_head}, hidden)
         params = {
@@ -132,21 +331,43 @@ class CompiledGptPipeline(CompiledBertPipeline):
         # spec applies to it uniformly)
         dummy_mb = jnp.zeros((M, B // M), hidden.dtype)
 
+        aux = None
         if self.virtual_stages > 1:
             encoded = self._interleaved_encoder(
                 params["stages"], hidden_mb, dummy_mb
             )
+        elif self.side_outputs:
+            # the side rides the ring as a per-microbatch aux accumulator
+            encoded, side_out = self._pipelined_encoder(
+                params["stages"], hidden_mb, dummy_mb
+            )
+            aux = side_out.mean()  # avg over microbatches of summed aux
         else:
             encoded = self._pipelined_encoder(
                 params["stages"], hidden_mb, dummy_mb
             )
         encoded = encoded.reshape(B, *encoded.shape[2:])
-        return self.lm_head.apply({"params": params["lm_head"]}, encoded)
+        logits = self.lm_head.apply({"params": params["lm_head"]}, encoded)
+        return (logits, aux) if self.side_outputs else logits
 
     def loss(self, params, batch, labels):
         (input_ids,) = batch if isinstance(batch, tuple) else (batch,)
-        logits = self._logits(params, input_ids)
-        return causal_lm_loss(logits, labels)
+        out = self._logits(params, input_ids)
+        if self.side_outputs:
+            logits, aux = out
+            return causal_lm_loss(logits, labels) + (
+                self.moe_aux_coef * aux.astype(jnp.float32)
+            )
+        return causal_lm_loss(out, labels)
 
 
-__all__ = ["CompiledGptPipeline", "GptEncoderStage", "GptEncoderUnit"]
+__all__ = [
+    "CompiledGptPipeline",
+    "GptEncoderStage",
+    "GptEncoderUnit",
+    "GptMoeEncoderStage",
+    "TpGptStage",
+    "TpGptUnit",
+    "GPT_TP_COL",
+    "GPT_TP_ROW",
+]

@@ -1,5 +1,6 @@
 from .checkpoint_hook import CheckpointHook
 from .eval_hook import EvalHook
+from .heartbeat_hook import HeartbeatHook
 from .metrics_hook import MetricsHook
 from .stop_hook import StopHook
 from .timer_hook import DistributedTimerHelperHook
@@ -8,6 +9,7 @@ from .watchdog_hook import NanGuardHook, WatchdogHook
 __all__ = [
     "CheckpointHook",
     "EvalHook",
+    "HeartbeatHook",
     "MetricsHook",
     "NanGuardHook",
     "StopHook",

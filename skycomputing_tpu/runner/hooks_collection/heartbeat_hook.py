@@ -1,0 +1,58 @@
+"""Periodic peer-liveness checks for multi-host training runs.
+
+Extends the failure-detection family (``watchdog_hook.py``) to the
+multi-process world: every N iterations ALL processes issue one timed
+global all-reduce (``parallel/heartbeat.py``).  Because the Runner drives
+every process through the same iteration sequence, the hook is a safe
+synchronization point for the collective.
+"""
+
+from __future__ import annotations
+
+from ...registry import HOOKS
+from ..hooks import Hook
+
+
+@HOOKS.register_module
+class HeartbeatHook(Hook):
+    """Beat every ``interval`` iterations; on failure abort (or stop).
+
+    ``action``: 'abort' (default) kills the process from the watchdog
+    thread so a scheduler can restart the world — the ONLY action that
+    works when the failure mode is a wedged collective, because
+    ``beat()`` then never returns (``block_until_ready`` cannot be
+    cancelled from Python) and post-beat code is unreachable.  'stop'
+    requests a clean Runner stop, which acts only when the failure
+    surfaces as a runtime exception (e.g. the coordination service
+    noticed a dead peer and errored the collective).
+    """
+
+    def __init__(self, interval: int = 50, timeout_s: float = 60.0,
+                 action: str = "abort"):
+        if action not in ("stop", "abort"):
+            raise ValueError(f"unknown action {action!r}")
+        from ...parallel.heartbeat import PeerHeartbeat
+
+        self._interval = int(interval)
+        self._heartbeat = PeerHeartbeat(
+            timeout_s=timeout_s, abort_on_failure=(action == "abort")
+        )
+        self._action = action
+
+    @property
+    def heartbeat(self):
+        return self._heartbeat
+
+    def after_iter(self, runner):
+        if not self.every_n_iters(runner, self._interval):
+            return
+        if self._heartbeat.beat():
+            return
+        runner.logger.info(
+            f"HeartbeatHook: peer failure detected at iter {runner.iter}"
+        )
+        if self._action == "stop":
+            runner.request_stop()
+
+
+__all__ = ["HeartbeatHook"]

@@ -1,0 +1,124 @@
+"""Peer-liveness detection: timed collectives + failure callbacks.
+
+Failure modes covered: a beat that completes (healthy), a beat that
+stalls past the timeout (wedged peer — watchdog timer fires), a beat
+whose collective raises (coordination service noticed a death), and a
+REAL two-process world where one peer exits and the survivor detects it.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from skycomputing_tpu.parallel import PeerHeartbeat
+
+
+def test_beat_healthy_single_process(devices):
+    hb = PeerHeartbeat(timeout_s=60.0)
+    assert hb.beat() is True
+    assert hb.failed is False
+    assert hb.beats == 1
+    assert hb.last_beat_s is not None and hb.last_beat_s < 60.0
+
+
+def test_beat_timeout_fires_watchdog(devices):
+    reasons = []
+    hb = PeerHeartbeat(timeout_s=0.05, on_failure=reasons.append)
+    hb._build()
+    real_fn = hb._beat_fn
+
+    def stalled(x):
+        time.sleep(0.3)
+        return real_fn(x)
+
+    hb._beat_fn = stalled
+    assert hb.beat() is False
+    assert hb.failed is True
+    assert reasons and "did not complete" in reasons[0]
+
+
+def test_beat_exception_counts_as_detection(devices):
+    reasons = []
+    hb = PeerHeartbeat(timeout_s=60.0, on_failure=reasons.append)
+    hb._build()
+
+    def broken(x):
+        raise RuntimeError("peer closed connection")
+
+    hb._beat_fn = broken
+    assert hb.beat() is False
+    assert hb.failed is True
+    assert reasons and "raised" in reasons[0]
+
+
+_SURVIVOR = textwrap.dedent(
+    """
+    import os, sys
+    import jax
+    from skycomputing_tpu.parallel import PeerHeartbeat, initialize_from_env
+
+    assert initialize_from_env() is True
+
+    def report_and_exit(reason):
+        # the main thread is irrecoverably blocked inside the wedged
+        # collective (block_until_ready cannot be cancelled), so the
+        # detection path must do its reporting and exit — exactly what
+        # HeartbeatHook's 'abort' action does in production
+        print("DETECTED_PEER_DEATH", flush=True)
+        os._exit(0)
+
+    hb = PeerHeartbeat(timeout_s=30.0, on_failure=report_and_exit)
+    ok_first = hb.beat()          # both peers alive: must succeed
+    assert ok_first, "first beat failed with both peers alive"
+    print("BEAT1_OK", flush=True)
+    if os.environ["SKYTPU_PROCESS_ID"] == "1":
+        os._exit(0)               # peer dies without leaving the world
+    # survivor: the next beat cannot complete; the watchdog timer (or a
+    # runtime error surfaced as an exception) triggers report_and_exit
+    hb.beat()
+    raise SystemExit("dead peer went undetected")
+    """
+)
+
+
+def test_two_process_peer_death_is_detected(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env["SKYTPU_COORDINATOR"] = f"127.0.0.1:{port}"
+        env["SKYTPU_NUM_PROCESSES"] = "2"
+        env["SKYTPU_PROCESS_ID"] = str(pid)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        # fast dead-client detection from the coordination service
+        env["JAX_COORDINATION_SERVICE_HEARTBEAT_TIMEOUT_SECONDS"] = "10"
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", _SURVIVOR],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    rc0, out0, err0 = outs[0]
+    assert "BEAT1_OK" in out0, f"rc={rc0}\n{out0}\n{err0}"
+    assert "DETECTED_PEER_DEATH" in out0, f"rc={rc0}\n{out0}\n{err0}"

@@ -12,11 +12,12 @@ from skycomputing_tpu.parallel import PipelineModel
 
 
 def build_pipeline(devices, n_workers=4, units=2, num_microbatches=1,
-                   batch=8, seq=16, slowdowns=None, seed=0):
-    cfg = bert_config("tiny", dtype="float32", hidden_dropout_prob=0.0,
-                      attention_probs_dropout_prob=0.0)
+                   batch=8, seq=16, slowdowns=None, seed=0, dropout=0.0):
+    cfg = bert_config("tiny", dtype="float32", hidden_dropout_prob=dropout,
+                      attention_probs_dropout_prob=dropout)
     model_cfg = bert_layer_configs(cfg, num_encoder_units=units,
-                                   num_classes=3, deterministic=True)
+                                   num_classes=3,
+                                   deterministic=(dropout == 0.0))
 
     wm = WorkerManager()
     wm.load_worker_pool_from_config(
@@ -188,3 +189,16 @@ def test_slowdown_inflates_step_time(devices):
     t0 = time.perf_counter(); slow.train_step(data, labels, rng=jax.random.key(1))
     t_slow = time.perf_counter() - t0
     assert t_slow > t_fast * 2, (t_fast, t_slow)
+
+
+def test_default_rng_is_deterministic_across_runs(devices):
+    """With dropout live and no caller rng, two identically-built models
+    replay the same per-call keys (counter-folded, not wall-clock)."""
+
+    def run():
+        model, data, labels, _ = build_pipeline(
+            devices, n_workers=2, batch=4, seq=8, dropout=0.1
+        )
+        return [float(model.train_step(data, labels)) for _ in range(3)]
+
+    assert run() == run()
