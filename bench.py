@@ -150,7 +150,12 @@ def main() -> int:
     repeats = int(os.getenv("SKYTPU_BENCH_REPEATS", "2"))
     seq = 128
 
+    def note(msg: str) -> None:
+        print(f"# [{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+              flush=True)
+
     devices = jax.devices()
+    note(f"backend up: {devices}")
     cfg = bert_config(preset, hidden_dropout_prob=0.0,
                       attention_probs_dropout_prob=0.0)
     model_cfg = bert_layer_configs(
@@ -178,7 +183,10 @@ def main() -> int:
         RandomTokenGenerator(batch_size=batch, seq_length=seq,
                              vocab_size=cfg.vocab_size),
     )
+    note("static model profile (eval_shape + cost_analysis)...")
     _, layer_mem = model_bench.benchmark()
+    note(f"model profile done: {len(layer_mem)} layers, "
+         f"{sum(layer_mem) / 1024:.1f} GB total estimate")
     # default budget: total capacity = 1.5x the model's own footprint, so
     # the instance is feasible at every preset but memory still binds the
     # allocator (worker capacity_i = budget / mem_skew_i, applied once by
@@ -228,10 +236,12 @@ def main() -> int:
                 stimulator=ProfileSkew(),
             ),
         )
+        note(f"{alloc_type}: profiling devices + allocating...")
         if alloc_type == "even":
             allocator.even_allocate()
         else:
             allocator.optimal_allocate()
+        note(f"{alloc_type}: allocation done")
 
         # the runtime slowdown sleep is for training emulation; disable it
         # here — the schedule model applies slowdowns to measured times
@@ -244,11 +254,14 @@ def main() -> int:
         model = PipelineModel(
             wm, ps, optax.sgd(1e-3), cross_entropy_loss, devices=devices
         )
+        note(f"{alloc_type}: pipeline built ({len(model.stages)} stages); "
+             f"running one sanity train step...")
 
         # end-to-end sanity: the pipeline actually trains
         loss = model.train_step(data, labels, rng=jax.random.key(0))
         if not np.isfinite(loss):
             raise RuntimeError(f"{alloc_type}: non-finite loss {loss}")
+        note(f"{alloc_type}: train step ok; measuring per-stage times...")
 
         measured = model.measure_stage_times(data, repeats=repeats,
                                              inner_iters=2)

@@ -92,10 +92,12 @@ class PeerHeartbeat:
             os._exit(self._abort_exit_code)
 
     def beat(self) -> bool:
-        """One timed global all-reduce; returns True when peers are live."""
-        if self._beat_fn is None:
-            self._build()
+        """One timed global all-reduce; returns True when peers are live.
 
+        The lazy first-call ``_build()`` (compile + warm-up collective)
+        runs INSIDE the watchdog window too — a peer that died before the
+        first beat wedges the warm-up exactly like a regular beat.
+        """
         timer = threading.Timer(
             self.timeout_s,
             lambda: self._fail(
@@ -107,6 +109,8 @@ class PeerHeartbeat:
         start = time.perf_counter()
         timer.start()
         try:
+            if self._beat_fn is None:
+                self._build()
             total = float(jax.block_until_ready(self._beat_fn(self._ones)))
         except Exception as exc:  # runtime noticed a dead peer
             timer.cancel()

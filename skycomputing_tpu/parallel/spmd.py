@@ -348,6 +348,7 @@ class CompiledBertPipeline:
         virtual_stages: int = 1,
         optimizer: Optional[optax.GradientTransformation] = None,
         zero1: bool = False,
+        zero2: bool = False,
     ):
         self.cfg = self._parse_config(config)
         self.mesh = mesh
@@ -393,6 +394,14 @@ class CompiledBertPipeline:
         self.zero1 = bool(zero1)
         if self.zero1 and self.dp == 1:
             raise ValueError("zero1 requires a 'dp' mesh axis of size > 1")
+        # ZeRO-2: additionally pin the GRADIENT tree to the same dp shards
+        # (with_sharding_constraint right at the value_and_grad output), so
+        # the full-size replicated gradient buffer never materializes —
+        # XLA reduce-scatters the cross-dp gradient sum straight into
+        # shards and every downstream optimizer op stays sharded.
+        self.zero2 = bool(zero2)
+        if self.zero2 and not self.zero1:
+            raise ValueError("zero2 extends zero1; pass zero1=True as well")
 
         self._build_modules(units_per_stage, num_classes)
 
@@ -838,6 +847,19 @@ class CompiledBertPipeline:
         @functools.partial(jax.jit, donate_argnums=(0, 1), **jit_kwargs)
         def train_step(params, opt_state, batch, labels):
             loss, grads = jax.value_and_grad(self.loss)(params, batch, labels)
+            if self.zero2:
+                # pin each gradient leaf to the same dp shards a
+                # ZeRO-sharded state tensor of that shape gets (params
+                # keep their own shardings; only their GRADIENTS live
+                # dp-sharded, so the full replicated grad buffer never
+                # materializes — the cross-dp sum reduce-scatters
+                # straight into shards)
+                grads = jax.tree_util.tree_map(
+                    lambda g: jax.lax.with_sharding_constraint(
+                        g, self._zero1_sharding(g)
+                    ),
+                    grads,
+                )
             updates, opt_state = self.optimizer.update(
                 grads, opt_state, params
             )

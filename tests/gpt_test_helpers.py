@@ -1,0 +1,23 @@
+"""Shared tiny-GPT fixtures for the compiled-pipeline test family.
+
+One definition so the base (test_spmd_gpt), TP (test_spmd_gpt_tp), and MoE
+(test_spmd_gpt_moe) suites provably exercise the SAME model.
+"""
+
+import numpy as np
+
+from skycomputing_tpu.models.gpt import GptConfig
+
+
+def tiny_gpt_config() -> GptConfig:
+    return GptConfig(vocab_size=512, hidden_size=64, num_hidden_layers=4,
+                     num_attention_heads=2, max_position_embeddings=64,
+                     dropout_prob=0.0, dtype="float32")
+
+
+def gpt_data(batch=8, seq=16):
+    """(input_ids, next-token labels) from a fixed seed."""
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, 512, size=(batch, seq)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1)
+    return ids, labels

@@ -18,17 +18,7 @@ from skycomputing_tpu.parallel import (
 )
 
 
-def _cfg():
-    return GptConfig(vocab_size=512, hidden_size=64, num_hidden_layers=4,
-                     num_attention_heads=2, max_position_embeddings=64,
-                     dropout_prob=0.0, dtype="float32")
-
-
-def _data(batch=8, seq=16):
-    rng = np.random.default_rng(0)
-    ids = rng.integers(1, 512, size=(batch, seq)).astype(np.int32)
-    labels = np.roll(ids, -1, axis=1)
-    return ids, labels
+from gpt_test_helpers import gpt_data as _data, tiny_gpt_config as _cfg
 
 
 def test_moe_pipeline_matches_sequential(devices):

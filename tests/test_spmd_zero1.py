@@ -64,3 +64,45 @@ def test_zero1_matches_replicated_training(devices):
         ),
         params_r, params_z,
     )
+
+
+def test_zero2_matches_zero1_training(devices):
+    """Gradient sharding is pure bookkeeping: same losses, same params."""
+    cfg = bert_config("tiny", dtype="float32", hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)
+    mesh = make_dp_pp_mesh(2, 4, devices)
+
+    def world(zero2):
+        pipe = CompiledBertPipeline(
+            cfg, mesh, units_per_stage=1, num_microbatches=2,
+            optimizer=optax.adam(1e-3), zero1=True, zero2=zero2,
+        )
+        rng = np.random.default_rng(0)
+        ids = rng.integers(5, 1024, size=(8, 16)).astype(np.int32)
+        batch = (ids, np.zeros_like(ids), np.ones_like(ids))
+        labels = rng.integers(0, 3, size=(8,)).astype(np.int32)
+        params = pipe.init(jax.random.key(0), *batch)
+        return pipe, params, pipe.init_opt_state(params), batch, labels
+
+    pipe_1, params_1, opt_1, batch, labels = world(zero2=False)
+    pipe_2, params_2, opt_2, _, _ = world(zero2=True)
+    for _ in range(3):
+        params_1, opt_1, loss_1 = pipe_1.train_step(params_1, opt_1, batch,
+                                                    labels)
+        params_2, opt_2, loss_2 = pipe_2.train_step(params_2, opt_2, batch,
+                                                    labels)
+        np.testing.assert_allclose(float(loss_1), float(loss_2), rtol=2e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        ),
+        params_1, params_2,
+    )
+
+
+def test_zero2_requires_zero1(devices):
+    cfg = bert_config("tiny", dtype="float32")
+    mesh = make_dp_pp_mesh(2, 4, devices)
+    import pytest
+    with pytest.raises(ValueError, match="zero2 extends zero1"):
+        CompiledBertPipeline(cfg, mesh, units_per_stage=1, zero2=True)
