@@ -349,6 +349,7 @@ class CompiledBertPipeline:
         optimizer: Optional[optax.GradientTransformation] = None,
         zero1: bool = False,
         zero2: bool = False,
+        zero3: bool = False,
     ):
         self.cfg = self._parse_config(config)
         self.mesh = mesh
@@ -374,18 +375,11 @@ class CompiledBertPipeline:
         self.tp = int(mesh.shape["tp"]) if "tp" in mesh.shape else 1
         self.units_per_stage = units_per_stage
         self.num_classes = num_classes
+        # interleaved scheduling accepts any M: the collision-free
+        # wavefront covers M <= S, the grouped Megatron schedule covers
+        # S | M, and other M pad up to the next multiple of S (pads are
+        # sliced away; see _interleaved_encoder)
         self.num_microbatches = num_microbatches or self.num_stages
-        if (
-            self.virtual_stages > 1
-            and self.num_microbatches > self.num_stages
-            and self.num_microbatches % self.num_stages != 0
-        ):
-            raise ValueError(
-                f"interleaved scheduling needs num_microbatches "
-                f"({self.num_microbatches}) <= num_stages "
-                f"({self.num_stages}), or a multiple of it (grouped "
-                f"Megatron schedule)"
-            )
         self.optimizer = optimizer or optax.sgd(learning_rate)
         # ZeRO-1: shard optimizer-state tensors (momenta etc.) over the dp
         # axis instead of replicating them.  Under jit this is nothing but
@@ -402,6 +396,22 @@ class CompiledBertPipeline:
         self.zero2 = bool(zero2)
         if self.zero2 and not self.zero1:
             raise ValueError("zero2 extends zero1; pass zero1=True as well")
+        # ZeRO-3 / FSDP: stage params live dp-SHARDED at rest (one weight
+        # axis split over 'dp' on top of the 'pp'/'tp' stacking) and are
+        # all-gathered inside the stage body right before use; the
+        # gather's transpose is a reduce-scatter, so gradients come out
+        # dp-sharded too and the optimizer update runs entirely on
+        # shards.  Param/state/grad memory all divide by dp.
+        self.zero3 = bool(zero3)
+        if self.zero3 and self.dp == 1:
+            raise ValueError("zero3 requires a 'dp' mesh axis of size > 1")
+        if self.zero3 and self.virtual_stages > 1:
+            raise NotImplementedError(
+                "zero3 composes with the plain GPipe schedule; "
+                "virtual_stages > 1 is not wired"
+            )
+        self._zero3_axes = None  # per-leaf gather axis, built by init()
+        self._stage_in_specs = None  # per-leaf specs (zero3), ditto
 
         self._build_modules(units_per_stage, num_classes)
 
@@ -431,6 +441,61 @@ class CompiledBertPipeline:
             num_classes=num_classes,
             deterministic=True,
             dtype=self.cfg.dtype,
+        )
+
+    def _pick_dp_axis(self, shape, first_axis: int) -> int:
+        """Last dp-divisible axis of ``shape`` at/after ``first_axis``.
+
+        The ONE rule shared by ZeRO state sharding (`_zero1_sharding`),
+        ZeRO-2 gradient pinning, and ZeRO-3 param sharding — all three
+        must agree or XLA reshards every stage gradient each step.
+        Returns -1 when no axis qualifies.
+        """
+        for ax in range(len(shape) - 1, first_axis - 1, -1):
+            if shape[ax] % self.dp == 0 and shape[ax] >= self.dp:
+                return ax
+        return -1
+
+    def _stage_shardings(self, stages):
+        """Per-leaf shardings for the stacked stage tree.
+
+        Without zero3 every leaf gets the uniform ``self._stage_spec``;
+        with zero3 one dp-divisible weight axis per leaf additionally
+        carries 'dp', and the per-leaf gather axis (post-extraction
+        coordinates, -1 = replicated) is recorded for the stage body.
+        """
+        stage_dims = 2 if self.tp > 1 else 1
+
+        class _SpecAx:  # opaque pair so tree_map treats it as a leaf
+            def __init__(self, spec, ax):
+                self.spec, self.ax = spec, ax
+
+        def spec_and_axis(leaf):
+            shape = np.shape(leaf)
+            spec = list(self._stage_spec) + [None] * (len(shape) - stage_dims)
+            ax = self._pick_dp_axis(shape, stage_dims) if self.zero3 else -1
+            if ax >= 0:
+                spec[ax] = "dp"
+            return _SpecAx(P(*spec), ax - stage_dims if ax >= 0 else -1)
+
+        pairs = jax.tree_util.tree_map(spec_and_axis, stages)
+        specs = jax.tree_util.tree_map(lambda p: p.spec, pairs)
+        self._zero3_axes = jax.tree_util.tree_map(lambda p: p.ax, pairs)
+        self._stage_in_specs = specs if self.zero3 else self._stage_spec
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s), specs
+        )
+
+    def _gather_zero3(self, params):
+        """all-gather dp-sharded leaves inside the stage body (zero3)."""
+        if not self.zero3:
+            return params
+        return jax.tree_util.tree_map(
+            lambda x, ax: (
+                lax.all_gather(x, "dp", axis=ax, tiled=True) if ax >= 0
+                else x
+            ),
+            params, self._zero3_axes,
         )
 
     # --- init ----------------------------------------------------------------
@@ -472,10 +537,7 @@ class CompiledBertPipeline:
         }
         self.param_shardings = {
             "embeddings": NamedSharding(self.mesh, self._repl_spec),
-            "stages": jax.tree_util.tree_map(
-                lambda _: NamedSharding(self.mesh, self._stage_spec),
-                stages,
-            ),
+            "stages": self._stage_shardings(stages),
             "pooler": NamedSharding(self.mesh, self._repl_spec),
             "classifier": NamedSharding(self.mesh, self._repl_spec),
         }
@@ -510,12 +572,8 @@ class CompiledBertPipeline:
                 shape[1] == self.tp
             ) else 1
         spec = (["pp", "tp"][: stage_axes] + [None] * (len(shape) - stage_axes))
-        best = None
-        for ax in range(len(shape) - 1, stage_axes - 1, -1):
-            if shape[ax] % self.dp == 0 and shape[ax] >= self.dp:
-                best = ax
-                break
-        if best is not None:
+        best = self._pick_dp_axis(shape, stage_axes)
+        if best >= 0:
             spec[best] = "dp"
         elif stage_axes == 0:
             return NamedSharding(self.mesh, P())  # replicated (embeddings
@@ -536,15 +594,21 @@ class CompiledBertPipeline:
         stack per-stage buffers along axis 0 and only the last device's
         block (the final stage/chunk) is meaningful.  With
         ``side_outputs`` the body returns a (hidden, side) buffer pair.
+        M comes from the input's leading axis (the padded count when the
+        grouped schedule padded up to a multiple of S).
         """
-        M = self.num_microbatches
+        M = hidden_mb.shape[0]
         act_spec = P(None, "dp") if self.dp > 1 else P()
         out_spec = P("pp", "dp") if self.dp > 1 else P("pp")
         out_specs = (out_spec, out_spec) if self.side_outputs else out_spec
+        stage_specs = (
+            self._stage_in_specs if self._stage_in_specs is not None
+            else self._stage_spec
+        )
         out = jax.shard_map(
             body,
             mesh=self.mesh,
-            in_specs=(self._stage_spec, act_spec, act_spec),
+            in_specs=(stage_specs, act_spec, act_spec),
             out_specs=out_specs,
             check_vma=False,
         )(stage_params, hidden_mb, mask_mb)
@@ -579,7 +643,7 @@ class CompiledBertPipeline:
     def _pipelined_encoder(self, stage_params, hidden_mb, mask_mb):
         """shard_map GPipe: [M, mb, L, H] -> [M, mb, L, H]."""
         S = self.num_stages
-        M = self.num_microbatches
+        M = hidden_mb.shape[0]
         tp = self.tp
         stage_mod = self.tp_stage if tp > 1 else self.stage
 
@@ -590,6 +654,7 @@ class CompiledBertPipeline:
                 (lambda x: x[0, 0]) if tp > 1 else (lambda x: x[0]),
                 local_stage_params,
             )
+            params = self._gather_zero3(params)
             params = self._guard_tp_replicated(params)
             idx = lax.axis_index("pp")
             fwd_perm = [(i, (i + 1) % S) for i in range(S)]
@@ -664,11 +729,26 @@ class CompiledBertPipeline:
                 "side-accumulating stages (MoE aux) are only wired into "
                 "the plain GPipe schedule; use virtual_stages=1"
             )
-        if self.num_microbatches > self.num_stages:
+        S = self.num_stages
+        M = hidden_mb.shape[0]
+        if M > S:
+            if M % S:
+                # pad with zero microbatches up to a multiple of S so the
+                # grouped wavefront applies; the pads ride the ring as
+                # extra bubble and their outputs are sliced away.  Cost:
+                # pad/M extra chunk-compute — still ahead of falling back
+                # to plain GPipe when V amortizes the bubble.
+                pad = S - M % S
+                zeros = lambda t: jnp.concatenate(
+                    [t, jnp.zeros((pad,) + t.shape[1:], t.dtype)], axis=0
+                )
+                return self._interleaved_grouped_encoder(
+                    stage_params, zeros(hidden_mb), zeros(mask_mb)
+                )[:M]
             return self._interleaved_grouped_encoder(
                 stage_params, hidden_mb, mask_mb
             )
-        S, V, M = self.num_stages, self.virtual_stages, self.num_microbatches
+        V = self.virtual_stages
         C = S * V
         T = M + C - 1
         tp = self.tp
@@ -727,7 +807,8 @@ class CompiledBertPipeline:
         Completed microbatches surface only at (d = S-1, k = V-1); all
         other ticks write to a scratch slot M that is sliced away.
         """
-        S, V, M = self.num_stages, self.virtual_stages, self.num_microbatches
+        S, V = self.num_stages, self.virtual_stages
+        M = hidden_mb.shape[0]  # caller pads to a multiple of S
         if M % S != 0:
             raise ValueError(
                 f"grouped interleaving needs microbatches ({M}) to be a "
@@ -843,6 +924,13 @@ class CompiledBertPipeline:
             jit_kwargs["out_shardings"] = (
                 self.param_shardings, self.opt_shardings, None
             )
+        elif self.zero3:
+            if self.param_shardings is None:
+                raise RuntimeError(
+                    "zero3=True needs init() before make_train_step() — "
+                    "the step pins updated params to their dp shards"
+                )
+            jit_kwargs["out_shardings"] = (self.param_shardings, None, None)
 
         @functools.partial(jax.jit, donate_argnums=(0, 1), **jit_kwargs)
         def train_step(params, opt_state, batch, labels):

@@ -305,9 +305,7 @@ class CompiledGptPipeline(CompiledBertPipeline):
         }
         self.param_shardings = {
             "embeddings": NamedSharding(self.mesh, self._repl_spec),
-            "stages": jax.tree_util.tree_map(
-                lambda _: NamedSharding(self.mesh, self._stage_spec), stages
-            ),
+            "stages": self._stage_shardings(stages),
             "lm_head": NamedSharding(self.mesh, self._repl_spec),
         }
         return jax.device_put(params, self.param_shardings)

@@ -60,13 +60,66 @@ def test_interleaved_trains(world):
     assert losses[-1] < losses[0], losses
 
 
-def test_interleaved_rejects_non_multiple_microbatches(devices):
-    cfg = bert_config("tiny", dtype="float32")
+def test_interleaved_padded_non_multiple_m_matches_sequential(devices):
+    """M=6 with S=4 pads to M'=8 grouped microbatches; pads are sliced
+    away, so the schedule must still equal sequential chunk application."""
+    cfg = bert_config("tiny", dtype="float32", hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)
     mesh = make_pipeline_mesh(4, devices)
-    # M > S is fine when S | M (grouped schedule); 6 = 1.5*S is not
-    with pytest.raises(ValueError, match="interleaved"):
-        CompiledBertPipeline(cfg, mesh, units_per_stage=1,
-                             num_microbatches=6, virtual_stages=2)
+    S, V, M = 4, 2, 6
+    pipe = CompiledBertPipeline(cfg, mesh, units_per_stage=1, num_classes=3,
+                                num_microbatches=M, virtual_stages=V)
+    rng = np.random.default_rng(2)
+    ids = rng.integers(5, 1024, size=(12, 16)).astype(np.int32)
+    types = np.zeros_like(ids)
+    mask = np.ones_like(ids)
+    labels = rng.integers(0, 3, size=(12,)).astype(np.int32)
+    params = pipe.init(jax.random.key(0), ids, types, mask)
+    logits = np.asarray(pipe._logits(params, ids, types, mask))
+
+    hidden, mask4 = pipe.embeddings.apply(
+        {"params": params["embeddings"]}, ids, types, mask
+    )
+    host_stages = jax.tree_util.tree_map(np.asarray, params["stages"])
+    for c in range(S * V):
+        p = (c % S) * V + (c // S)
+        chunk_params = jax.tree_util.tree_map(lambda x: x[p], host_stages)
+        hidden, mask4 = pipe.stage.apply(
+            {"params": chunk_params}, hidden, mask4
+        )
+    pooled = pipe.pooler.apply({"params": params["pooler"]}, hidden, mask4)
+    ref = np.asarray(
+        pipe.classifier.apply({"params": params["classifier"]}, pooled)
+    )
+    np.testing.assert_allclose(logits, ref, rtol=3e-4, atol=3e-5)
+
+    # backward through the pad/slice path: gradients must equal the
+    # sequential chunk-application gradients (pad cotangents must not
+    # leak into real microbatches)
+    import optax as _optax
+
+    def ref_loss(p):
+        h, m4 = pipe.embeddings.apply({"params": p["embeddings"]}, ids,
+                                      types, mask)
+        for c in range(S * V):
+            sp = jax.tree_util.tree_map(
+                lambda x: x[(c % S) * V + (c // S)], p["stages"]
+            )
+            h, m4 = pipe.stage.apply({"params": sp}, h, m4)
+        pooled = pipe.pooler.apply({"params": p["pooler"]}, h, m4)
+        lg = pipe.classifier.apply({"params": p["classifier"]}, pooled)
+        return _optax.softmax_cross_entropy_with_integer_labels(
+            lg.astype(np.float32), labels
+        ).mean()
+
+    grads = jax.grad(pipe.loss)(params, (ids, types, mask), labels)
+    ref_grads = jax.grad(ref_loss)(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=3e-3, atol=3e-5
+        ),
+        grads, ref_grads,
+    )
 
 
 def test_grouped_interleaved_m_gt_s_matches_sequential(devices):
