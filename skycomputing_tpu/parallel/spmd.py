@@ -669,9 +669,8 @@ class CompiledBertPipeline:
                            jnp.zeros_like(mask_mb))
 
                 def tick_side(carry, t):
-                    (st_h, st_s), (out_h, out_s) = carry
-                    recv_h = lax.ppermute(st_h, "pp", fwd_perm)
-                    recv_s = lax.ppermute(st_s, "pp", fwd_perm)
+                    state, (out_h, out_s) = carry
+                    recv_h, recv_s = lax.ppermute(state, "pp", fwd_perm)
                     feed = jnp.clip(t, 0, M - 1)
                     inp_h = jnp.where(idx == 0, hidden_mb[feed], recv_h)
                     inp_s = jnp.where(idx == 0, mask_mb[feed], recv_s)
@@ -724,11 +723,6 @@ class CompiledBertPipeline:
         feeds chunk vS on device 0).  For M > S (M a multiple of S) the
         grouped variant below runs instead.
         """
-        if self.side_outputs:
-            raise NotImplementedError(
-                "side-accumulating stages (MoE aux) are only wired into "
-                "the plain GPipe schedule; use virtual_stages=1"
-            )
         S = self.num_stages
         M = hidden_mb.shape[0]
         if M > S:
@@ -742,9 +736,12 @@ class CompiledBertPipeline:
                 zeros = lambda t: jnp.concatenate(
                     [t, jnp.zeros((pad,) + t.shape[1:], t.dtype)], axis=0
                 )
-                return self._interleaved_grouped_encoder(
+                out = self._interleaved_grouped_encoder(
                     stage_params, zeros(hidden_mb), zeros(mask_mb)
-                )[:M]
+                )
+                if self.side_outputs:
+                    return out[0][:M], out[1][:M]
+                return out[:M]
             return self._interleaved_grouped_encoder(
                 stage_params, hidden_mb, mask_mb
             )
@@ -759,16 +756,52 @@ class CompiledBertPipeline:
             d = lax.axis_index("pp")
             fwd_perm = [(i, (i + 1) % S) for i in range(S)]
 
+            def tick_coords(t):
+                """t -> (chunk slot k_c, microbatch m_c, write index w)."""
+                k = (t - d) // S  # jnp floor-division: negative -> k < 0
+                m = t - d - S * k
+                k_c = jnp.clip(k, 0, V - 1)
+                m_c = jnp.clip(m, 0, M - 1)
+                w = jnp.clip(t - (C - 1), 0, M - 1)
+                return k_c, m_c, w
+
+            if self.side_outputs:
+                # the side travels WITH the microbatch between chunks
+                # (aux accumulator), so it rides the ring alongside hidden
+                state = (jnp.zeros_like(hidden_mb[0]),
+                         jnp.zeros_like(mask_mb[0]))
+                outputs = (jnp.zeros_like(hidden_mb),
+                           jnp.zeros_like(mask_mb))
+
+                def tick_side(carry, t):
+                    state, (out_h, out_s) = carry
+                    recv_h, recv_s = lax.ppermute(state, "pp", fwd_perm)
+                    k_c, m_c, w = tick_coords(t)
+                    params_k = self._select_chunk_params(
+                        local_stage_params, k_c
+                    )
+                    first = (d == 0) & (k_c == 0)
+                    inp_h = jnp.where(first, hidden_mb[m_c], recv_h)
+                    inp_s = jnp.where(first, mask_mb[m_c], recv_s)
+                    h, s = stage_mod.apply(
+                        {"params": params_k}, inp_h, inp_s
+                    )
+                    out_h = lax.dynamic_update_index_in_dim(out_h, h, w, 0)
+                    out_s = lax.dynamic_update_index_in_dim(out_s, s, w, 0)
+                    return ((h, s), (out_h, out_s)), None
+
+                (_, outputs), _ = lax.scan(
+                    tick_side, (state, outputs), jnp.arange(T)
+                )
+                return outputs
+
             state = jnp.zeros_like(hidden_mb[0])
             outputs = jnp.zeros_like(hidden_mb)
 
             def tick(carry, t):
                 state, outputs = carry
                 recv = lax.ppermute(state, "pp", fwd_perm)
-                k = (t - d) // S  # jnp floor-division: negative -> k < 0
-                m = t - d - S * k
-                k_c = jnp.clip(k, 0, V - 1)
-                m_c = jnp.clip(m, 0, M - 1)
+                k_c, m_c, w = tick_coords(t)
 
                 params_k = self._select_chunk_params(local_stage_params, k_c)
                 is_first_chunk = (d == 0) & (k_c == 0)
@@ -777,8 +810,8 @@ class CompiledBertPipeline:
                     {"params": params_k}, inp, mask_mb[m_c]
                 )
                 # idle ticks (bubble) compute on clamped inputs; their
-                # outputs are never consumed by an active receiver
-                w = jnp.clip(t - (C - 1), 0, M - 1)
+                # outputs are never consumed by an active receiver, and
+                # their writes (w clipped) are overwritten at t == C-1
                 outputs = lax.dynamic_update_index_in_dim(
                     outputs, out, w, axis=0
                 )
@@ -823,15 +856,8 @@ class CompiledBertPipeline:
             d = lax.axis_index("pp")
             fwd_perm = [(i, (i + 1) % S) for i in range(S)]
 
-            state = jnp.zeros_like(hidden_mb[0])
-            # slot M is the scratch target for bubble/non-final writes
-            outputs = jnp.zeros(
-                (M + 1,) + hidden_mb.shape[1:], hidden_mb.dtype
-            )
-
-            def tick(carry, t):
-                state, outputs = carry
-                recv = lax.ppermute(state, "pp", fwd_perm)
+            def tick_coords(t):
+                """tau -> (active, chunk slot k_c, microbatch m_c, done)."""
                 tau = t - d
                 g = tau // (V * S)  # floor division: negative while filling
                 r = tau - g * (V * S)
@@ -841,6 +867,51 @@ class CompiledBertPipeline:
                 active = (tau >= 0) & (m >= 0) & (m < M)
                 k_c = jnp.clip(k, 0, V - 1)
                 m_c = jnp.clip(m, 0, M - 1)
+                done = active & (k_c == V - 1)
+                return active, k_c, m_c, done
+
+            if self.side_outputs:
+                state = (jnp.zeros_like(hidden_mb[0]),
+                         jnp.zeros_like(mask_mb[0]))
+                outputs = (
+                    jnp.zeros((M + 1,) + hidden_mb.shape[1:],
+                              hidden_mb.dtype),
+                    jnp.zeros((M + 1,) + mask_mb.shape[1:], mask_mb.dtype),
+                )
+
+                def tick_side(carry, t):
+                    state, (out_h, out_s) = carry
+                    recv_h, recv_s = lax.ppermute(state, "pp", fwd_perm)
+                    active, k_c, m_c, done = tick_coords(t)
+                    params_k = self._select_chunk_params(
+                        local_stage_params, k_c
+                    )
+                    first = (d == 0) & (k_c == 0) & active
+                    inp_h = jnp.where(first, hidden_mb[m_c], recv_h)
+                    inp_s = jnp.where(first, mask_mb[m_c], recv_s)
+                    h, s = stage_mod.apply(
+                        {"params": params_k}, inp_h, inp_s
+                    )
+                    w = jnp.where(done, m_c, M)
+                    out_h = lax.dynamic_update_index_in_dim(out_h, h, w, 0)
+                    out_s = lax.dynamic_update_index_in_dim(out_s, s, w, 0)
+                    return ((h, s), (out_h, out_s)), None
+
+                (_, (out_h, out_s)), _ = lax.scan(
+                    tick_side, (state, outputs), jnp.arange(T)
+                )
+                return out_h[:M], out_s[:M]
+
+            state = jnp.zeros_like(hidden_mb[0])
+            # slot M is the scratch target for bubble/non-final writes
+            outputs = jnp.zeros(
+                (M + 1,) + hidden_mb.shape[1:], hidden_mb.dtype
+            )
+
+            def tick(carry, t):
+                state, outputs = carry
+                recv = lax.ppermute(state, "pp", fwd_perm)
+                active, k_c, m_c, done = tick_coords(t)
 
                 params_k = self._select_chunk_params(local_stage_params, k_c)
                 is_first_chunk = (d == 0) & (k_c == 0)
@@ -850,7 +921,6 @@ class CompiledBertPipeline:
                     {"params": params_k}, inp, mask_mb[m_c]
                 )
                 # only the final chunk's completions are real outputs
-                done = active & (k_c == V - 1)
                 w = jnp.where(done, m_c, M)
                 outputs = lax.dynamic_update_index_in_dim(
                     outputs, out, w, axis=0

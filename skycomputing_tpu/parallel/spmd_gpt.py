@@ -258,10 +258,10 @@ class CompiledGptPipeline(CompiledBertPipeline):
         cfg_dict = self.cfg.to_dict()
         self.embeddings = GptEmbeddings(cfg_dict, deterministic=True)
         if self.moe_every:
-            if self.tp > 1 or self.virtual_stages > 1:
+            if self.tp > 1:
                 raise NotImplementedError(
-                    "MoE stages compose with the plain GPipe schedule "
-                    "(virtual_stages=1) without tensor parallelism"
+                    "MoE stages do not compose with in-pipeline tensor "
+                    "parallelism yet"
                 )
             self.stage = GptMoeEncoderStage(
                 cfg_dict, units_per_stage, self.moe_every,
@@ -330,20 +330,13 @@ class CompiledGptPipeline(CompiledBertPipeline):
         dummy_mb = jnp.zeros((M, B // M), hidden.dtype)
 
         aux = None
-        if self.virtual_stages > 1:
-            encoded = self._interleaved_encoder(
-                params["stages"], hidden_mb, dummy_mb
-            )
-        elif self.side_outputs:
+        encoder = (self._interleaved_encoder if self.virtual_stages > 1
+                   else self._pipelined_encoder)
+        encoded = encoder(params["stages"], hidden_mb, dummy_mb)
+        if self.side_outputs:
             # the side rides the ring as a per-microbatch aux accumulator
-            encoded, side_out = self._pipelined_encoder(
-                params["stages"], hidden_mb, dummy_mb
-            )
+            encoded, side_out = encoded
             aux = side_out.mean()  # avg over microbatches of summed aux
-        else:
-            encoded = self._pipelined_encoder(
-                params["stages"], hidden_mb, dummy_mb
-            )
         encoded = encoded.reshape(B, *encoded.shape[2:])
         logits = self.lm_head.apply({"params": params["lm_head"]}, encoded)
         return (logits, aux) if self.side_outputs else logits
