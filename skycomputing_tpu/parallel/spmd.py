@@ -247,6 +247,25 @@ BERT_TP_COL = ("query", "key", "value", "dense_act")
 BERT_TP_ROW = ("dense",)
 
 
+def _tp_split_axis(module, param, col_modules, row_modules):
+    """Which axis of a full leaf splits across tp; None = replicate.
+
+    Role sets may name a whole submodule (every param of a Dense) or a
+    specific ``(module, param)`` pair (direct params, e.g. MoE expert
+    tensors).  Column-parallel leaves split their LAST axis (output
+    features / expert up-projection); row-parallel ones split the
+    second-to-last (input features), with module-matched row biases
+    replicated (they are added after the psum).
+    """
+    if module in col_modules or (module, param) in col_modules:
+        return -1
+    if (module, param) in row_modules:
+        return -2
+    if module in row_modules and param == "kernel":
+        return -2
+    return None
+
+
 def split_stage_params_for_tp(stages, tp: int,
                               col_modules=BERT_TP_COL,
                               row_modules=BERT_TP_ROW):
@@ -255,26 +274,25 @@ def split_stage_params_for_tp(stages, tp: int,
     Column-parallel leaves (q/k/v, FFN up) slice output features; row-
     parallel kernels (attention out, FFN down) slice input features; biases
     of row-parallel layers and LayerNorms replicate across tp.
-    ``col_modules``/``row_modules`` name the Dense submodules playing each
-    role (defaults match the BERT encoder; the GPT engine passes its own).
+    ``col_modules``/``row_modules`` name the submodules (or
+    ``(module, param)`` pairs) playing each role — defaults match the BERT
+    encoder; the GPT engines pass their own.
     """
 
     def split(path, leaf):
         module, param = _leaf_role(path)
-        P_ = leaf.shape[0]
-        if module in col_modules:
-            if param == "kernel":
-                i, o = leaf.shape[1:]
-                return leaf.reshape(P_, i, tp, o // tp).transpose(0, 2, 1, 3)
-            o = leaf.shape[1]
-            return leaf.reshape(P_, tp, o // tp)
-        if module in row_modules and param == "kernel":
-            i, o = leaf.shape[1:]
-            return leaf.reshape(P_, tp, i // tp, o)
-        # row-parallel bias, LayerNorm scale/bias: replicate
-        return jnp.broadcast_to(
-            leaf[:, None], (P_, tp) + leaf.shape[1:]
+        ax = _tp_split_axis(module, param, col_modules, row_modules)
+        if ax is None:
+            # row-parallel bias, LayerNorm scale/bias, routers: replicate
+            return jnp.broadcast_to(
+                leaf[:, None], (leaf.shape[0], tp) + leaf.shape[1:]
+            )
+        k = ax % leaf.ndim
+        shape = leaf.shape
+        parts = leaf.reshape(
+            shape[:k] + (tp, shape[k] // tp) + shape[k + 1:]
         )
+        return jnp.moveaxis(parts, k, 1)
 
     return jax.tree_util.tree_map_with_path(split, stages)
 
@@ -314,16 +332,16 @@ def merge_stage_params_from_tp(stages_tp,
 
     def merge(path, leaf):
         module, param = _leaf_role(path)
-        P_, tp = leaf.shape[:2]
-        if module in col_modules:
-            if param == "kernel":
-                i, o = leaf.shape[2:]
-                return leaf.transpose(0, 2, 1, 3).reshape(P_, i, tp * o)
-            return leaf.reshape(P_, -1)
-        if module in row_modules and param == "kernel":
-            i, o = leaf.shape[2:]
-            return leaf.reshape(P_, tp * i, o)
-        return leaf[:, 0]
+        ax = _tp_split_axis(module, param, col_modules, row_modules)
+        if ax is None:
+            return leaf[:, 0]
+        # leaf: [P, tp, ...local...]; put tp back next to its split axis
+        k = ax % (leaf.ndim - 1)  # axis index in the FULL (merged) leaf
+        parts = jnp.moveaxis(leaf, 1, k)
+        shape = parts.shape
+        return parts.reshape(
+            shape[:k] + (shape[k] * shape[k + 1],) + shape[k + 2:]
+        )
 
     return jax.tree_util.tree_map_with_path(merge, stages_tp)
 
@@ -405,11 +423,6 @@ class CompiledBertPipeline:
         self.zero3 = bool(zero3)
         if self.zero3 and self.dp == 1:
             raise ValueError("zero3 requires a 'dp' mesh axis of size > 1")
-        if self.zero3 and self.virtual_stages > 1:
-            raise NotImplementedError(
-                "zero3 composes with the plain GPipe schedule; "
-                "virtual_stages > 1 is not wired"
-            )
         self._zero3_axes = None  # per-leaf gather axis, built by init()
         self._stage_in_specs = None  # per-leaf specs (zero3), ditto
 
@@ -624,21 +637,28 @@ class CompiledBertPipeline:
 
         def guard(path, leaf):
             module, param = _leaf_role(path)
-            if module in col or (module in row and param == "kernel"):
+            if _tp_split_axis(module, param, col, row) is not None:
                 return leaf  # genuinely sharded: transpose is exact
             return _psum_grad_tp(leaf)
 
         return jax.tree_util.tree_map_with_path(guard, local_stage_params)
 
     def _select_chunk_params(self, local_stage_params, k_c):
-        """This device's chunk ``k_c`` from its [V, (tp,) ...] local leaves."""
+        """This device's chunk ``k_c`` from its [V, (tp,) ...] local leaves.
+
+        With zero3 the selected chunk is all-gathered over dp HERE, per
+        tick — FSDP-style streaming: only the chunk in use is ever
+        materialized full-size, the rest stay sharded at rest.
+        """
         tp = self.tp
 
         def index_chunk(x):
             x = lax.dynamic_index_in_dim(x, k_c, 0, keepdims=False)
             return x[0] if tp > 1 else x
 
-        return jax.tree_util.tree_map(index_chunk, local_stage_params)
+        return self._gather_zero3(
+            jax.tree_util.tree_map(index_chunk, local_stage_params)
+        )
 
     def _pipelined_encoder(self, stage_params, hidden_mb, mask_mb):
         """shard_map GPipe: [M, mb, L, H] -> [M, mb, L, H]."""

@@ -202,3 +202,23 @@ def test_default_rng_is_deterministic_across_runs(devices):
         return [float(model.train_step(data, labels)) for _ in range(3)]
 
     assert run() == run()
+
+
+def test_measure_stage_times_dedups_identical_stages(devices):
+    """Stages sharing (structure, input signature, device) reuse one timed
+    measurement; distinct structures still measure separately."""
+    # 1 + 3*3 + 2 = 12 layers over 4 same-device stages of 3: the two
+    # interior stages are identical trio windows (same phase)
+    model, data, *_ = build_pipeline(devices[:1] * 4, n_workers=4, units=3)
+    times = model.measure_stage_times(data, repeats=1, inner_iters=1)
+    assert len(times) == 4
+    keys = [s.config_key for s in model.stages]
+    for i in range(4):
+        for j in range(i + 1, 4):
+            if keys[i] == keys[j]:
+                assert times[i] == times[j], (i, j, times)
+    # at least one pair must have deduped in this partition
+    assert any(
+        keys[i] == keys[j] and times[i] == times[j]
+        for i in range(4) for j in range(i + 1, 4)
+    )
