@@ -45,6 +45,8 @@ class Allocator:
     def _profiles(self):
         device_results = self._device_benchmarker.benchmark()
         layer_flops, layer_mem = self._model_benchmarker.benchmark()
+        if getattr(self, "_cost_override", None) is not None:
+            layer_flops = list(self._cost_override)
 
         worker_ranks = [
             int(name.lstrip("worker")) for name in device_results.keys()
@@ -98,9 +100,14 @@ class Allocator:
             device_time=device_time,
             device_mem=device_mem,
         )
+        # exposed for callers that report provenance (bench.py stamps the
+        # certified optimality gap into its JSON artifact)
+        self.last_result = result
         self._logger.info(
             f"optimal bottleneck: {result.bottleneck:.4g} "
-            f"(device order {result.device_order})"
+            f"(certified lower bound {result.lower_bound:.4g}, gap "
+            f"{result.optimality_gap:.4f}, device order "
+            f"{result.device_order})"
         )
 
         ranges = result.as_ranges(len(worker_ranks))
@@ -115,6 +122,57 @@ class Allocator:
                 orders[d] = pos
                 pos += 1
         return self._apply_partition(worker_ranks, ranges, orders)
+
+    # ----------------------------------------------------- closed-loop refine
+    def refine_allocation(self, measured_stage_times) -> WorkerManager:
+        """Re-allocate with per-layer costs calibrated to MEASURED stage
+        times — closed-loop allocation.
+
+        Per-layer profiles (static FLOPs or isolated timed units) cannot
+        see slice-level effects: cache pressure makes a 10-unit stage cost
+        more than 10 x one unit, so the solver underestimates big slices
+        and overloads fast devices.  This pass rescales every layer's cost
+        by its own stage's measured/predicted ratio (the reference's
+        ``dynamic_allocate`` rebalanced iteratively on flops x time for
+        the same reason, ``scaelum/dynamics/allocator.py:181-257``; here
+        the feedback is real wall time) and re-solves.  Call after
+        ``optimal_allocate`` + a measurement pass
+        (``PipelineModel.measure_stage_times``); iterate to converge —
+        each round's slices change the slice-size effects being modeled.
+
+        ``measured_stage_times`` are raw per-stage seconds, pipeline
+        order, one per worker with a non-empty slice.
+        """
+        base_costs, _ = self._model_benchmarker.benchmark()
+        costs = list(
+            self._cost_override
+            if getattr(self, "_cost_override", None) is not None
+            else base_costs
+        )
+
+        workers = sorted(
+            (w for w in self._worker_manager.worker_pool if w.model_config),
+            key=lambda w: w.order,
+        )
+        if len(workers) != len(measured_stage_times):
+            raise ValueError(
+                f"{len(measured_stage_times)} measured times for "
+                f"{len(workers)} non-empty stages"
+            )
+        pos = 0
+        for worker, t in zip(workers, measured_stage_times):
+            n = len(worker.model_config)
+            pred = sum(costs[pos:pos + n])
+            if pred > 0 and t > 0:
+                scale = float(t) / pred
+                costs[pos:pos + n] = [c * scale for c in costs[pos:pos + n]]
+            pos += n
+        if pos != len(costs):
+            raise ValueError(
+                f"stage slices cover {pos} layers, model has {len(costs)}"
+            )
+        self._cost_override = costs
+        return self.optimal_allocate()
 
     # --------------------------------------------------------------- dynamic
     def dynamic_allocate(self, break_iter: int = 1000) -> WorkerManager:

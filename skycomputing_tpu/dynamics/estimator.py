@@ -124,10 +124,139 @@ class Estimator:
         return out_aval, flops, mem_usage
 
     @staticmethod
+    def estimate_memory(module, data: Sequence[Any], param_scale: int = 2,
+                        rng: jax.Array = None):
+        """(output_avals, mem_MB) — the static memory half of
+        :meth:`benchmark_model` without the FLOPs compile (for callers
+        that already measure cost some other way, e.g. timed profiling)."""
+        if rng is None:
+            rng = jax.random.key(0)
+        data = _as_tuple(data)
+        avals = tuple(
+            jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype)
+            if not isinstance(x, jax.ShapeDtypeStruct)
+            else x
+            for x in data
+        )
+        variables_aval = jax.eval_shape(
+            lambda *xs: module.init({"params": rng, "dropout": rng}, *xs),
+            *avals,
+        )
+        params_aval = variables_aval["params"]
+        out_aval = jax.eval_shape(
+            lambda params, *xs: module.apply(
+                {"params": params}, *xs, rngs={"dropout": rng}
+            ),
+            params_aval, *avals,
+        )
+        mb = 1024.0**2
+        mem_usage = (
+            _aval_bytes(avals, 4.0) / mb
+            + 2.0 * _aval_bytes(out_aval, 4.0) / mb
+            + param_scale * _aval_bytes(params_aval, 4.0) / mb
+        )
+        return out_aval, mem_usage
+
+    @staticmethod
     def measure_flops(fn: Callable, *args) -> float:
         """XLA-reported FLOPs of an arbitrary jittable function."""
         compiled = jax.jit(fn).lower(*args).compile()
         return float(compiled.cost_analysis().get("flops", 0.0))
+
+    @staticmethod
+    def benchmark_train_time(
+        module,
+        data: Sequence[Any],
+        rng: jax.Array = None,
+        iterations: int = 8,
+        warmup: int = 2,
+        repeats: int = 3,
+        device=None,
+    ) -> Tuple[Any, float]:
+        """(outputs, measured fwd+bwd seconds per iteration) for one layer.
+
+        The *timed* counterpart of :meth:`benchmark_model`: builds real
+        params, jits one forward+backward (gradients w.r.t. params and
+        inputs — what a pipeline stage actually computes each tick), warms
+        the executable, then takes the best of ``repeats`` timed loops of
+        ``iterations`` chained executions with one final block, matching
+        the discipline of ``PipelineModel.measure_stage_times`` so
+        allocator inputs and realized stage times live on the same scale.
+        XLA's static FLOP count is a poor proxy for wall time on
+        memory-bound units (softmax/LayerNorm-heavy attention thirds vs
+        matmul-heavy FFN thirds), which mis-ranks layers for the
+        allocator; measuring closes that gap.
+        """
+        if rng is None:
+            rng = jax.random.key(0)
+        data = _as_tuple(data)
+        if device is not None:
+            data = tuple(jax.device_put(x, device) for x in data)
+        variables = module.init({"params": rng, "dropout": rng}, *data)
+        params = variables["params"]
+        if device is not None:
+            params = jax.device_put(params, device)
+
+        def apply_fn(params, *xs):
+            return module.apply({"params": params}, *xs, rngs={"dropout": rng})
+
+        # Time what a pipeline stage computes each tick: the forward
+        # OUTPUTS (handed downstream — returned so XLA cannot dead-code
+        # any of the forward) plus the vjp against a full-size cotangent,
+        # w.r.t. params and the FLOAT inputs (upstream cotangents; integer
+        # inputs like token ids are non-differentiable pass-throughs).  A
+        # ``grad(sum(out))`` objective would let XLA elide most of the
+        # forward — gradients of linear ops don't need their outputs.
+        is_diff = tuple(
+            jnp.issubdtype(np.asarray(x).dtype, np.inexact) for x in data
+        )
+
+        def train_like(params, diff_xs, int_xs, cotangent):
+            def fwd(params, diff_xs):
+                xs, di, ii = [], iter(diff_xs), iter(int_xs)
+                for d in is_diff:
+                    xs.append(next(di) if d else next(ii))
+                return apply_fn(params, *xs)
+
+            out, vjp = jax.vjp(fwd, params, diff_xs)
+            return out, vjp(cotangent)
+
+        outputs = apply_fn(params, *data)
+        diff_xs = tuple(x for x, d in zip(data, is_diff) if d)
+        int_xs = tuple(x for x, d in zip(data, is_diff) if not d)
+
+        def fwd_shapes(params, diff_xs, int_xs):
+            xs, di, ii = [], iter(diff_xs), iter(int_xs)
+            for d in is_diff:
+                xs.append(next(di) if d else next(ii))
+            return apply_fn(params, *xs)
+
+        # cotangent dtypes must match the TRACED outputs — weak-type
+        # promotion differs between closed-over constants and traced
+        # arguments, so eval_shape must receive every input as an
+        # argument, exactly like the jitted step below does
+        cotangent = jax.tree_util.tree_map(
+            lambda a: (
+                jnp.ones(a.shape, a.dtype)
+                if jnp.issubdtype(a.dtype, jnp.inexact)
+                else np.zeros(a.shape, jax.dtypes.float0)
+            ),
+            jax.eval_shape(fwd_shapes, params, diff_xs, int_xs),
+        )
+        step = jax.jit(train_like)
+        result = None
+        for _ in range(max(warmup, 1)):
+            result = step(params, diff_xs, int_xs, cotangent)
+        jax.block_until_ready(result)
+
+        best = float("inf")
+        for _ in range(repeats):
+            start = time.perf_counter()
+            for _ in range(iterations):
+                result = step(params, diff_xs, int_xs, cotangent)
+            jax.block_until_ready(result)
+            best = min(best, (time.perf_counter() - start) / iterations)
+        return outputs, best
 
 
 __all__ = ["Estimator"]

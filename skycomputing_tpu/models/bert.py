@@ -230,6 +230,82 @@ class BertLayer_Body(nn.Module):
 
 
 @LAYER.register_module
+class BertLayer_BodyShard(nn.Module):
+    """A column-slice of the FFN up-projection — finer allocation units.
+
+    The reference's allocation granularity stops at ⅓ encoder layer
+    (``bert_layers.py:330-363``); the FFN up-projection is that
+    decomposition's chunkiest unit and therefore pins the allocator's
+    achievable bottleneck on heterogeneous clusters (an indivisible unit
+    of cost c forces every device holding it to spend ``slowdown x c``).
+    Since the activation applies elementwise, the up-projection splits
+    EXACTLY by output columns:
+
+        act(x @ W1) == concat_k act(x @ W1[:, k-th column block])
+
+    so ``num_shards`` of these units chained behind ``BertLayer_Head``
+    reproduce ``BertLayer_Body`` exactly up to GEMM tiling/rounding (the
+    columns never mix) while letting the allocator place half-FFN units
+    on slow devices.  Shard 0 consumes (attention_output, mask); later shards
+    additionally thread the concatenated-so-far intermediate.  The last
+    shard's output tuple matches ``BertLayer_Body``'s, so
+    ``BertLayer_Tail`` follows unchanged.  ``split_body_params`` maps a
+    monolithic body checkpoint onto the shards.
+    """
+
+    config: Any
+    shard: int = 0
+    num_shards: int = 2
+    deterministic: bool = False
+
+    @nn.compact
+    def __call__(self, *args):
+        cfg = _cfg(self.config)
+        if cfg.intermediate_size % self.num_shards:
+            raise ValueError(
+                f"intermediate_size {cfg.intermediate_size} not divisible "
+                f"by ffn shards {self.num_shards}"
+            )
+        if self.shard == 0:
+            attention_output, attention_mask = args
+            inter_sofar = None
+        else:
+            inter_sofar, attention_output, attention_mask = args
+        act = ACT2FN[cfg.hidden_act]
+        part = act(
+            _dense(
+                cfg, cfg.intermediate_size // self.num_shards, "dense_act"
+            )(attention_output)
+        )
+        inter = (
+            part if inter_sofar is None
+            else jnp.concatenate([inter_sofar, part], axis=-1)
+        )
+        return inter, attention_output, attention_mask
+
+
+def split_body_params(body_params: dict, num_shards: int) -> list:
+    """Monolithic ``BertLayer_Body`` params -> per-shard param trees.
+
+    Column-slices ``dense_act`` kernel/bias; exact inverse of
+    concatenating the shards' outputs (checkpoint interop for the
+    fine-grained decomposition).
+    """
+    kernel = body_params["dense_act"]["kernel"]
+    bias = body_params["dense_act"]["bias"]
+    width = kernel.shape[-1] // num_shards
+    return [
+        {
+            "dense_act": {
+                "kernel": kernel[..., k * width:(k + 1) * width],
+                "bias": bias[..., k * width:(k + 1) * width],
+            }
+        }
+        for k in range(num_shards)
+    ]
+
+
+@LAYER.register_module
 class BertLayer_Tail(nn.Module):
     """FFN down-projection + residual third (``bert_layers.py:354-363``)."""
 
@@ -290,19 +366,42 @@ def bert_layer_configs(
     num_encoder_units: int,
     num_classes: int = 3,
     deterministic: bool = False,
+    ffn_shards: int = 1,
 ) -> list:
     """Assemble the full layer-config list for a stacked BERT classifier.
 
     Matches the reference experiment's assembly (``experiment/config.py:26-49``):
     1 embeddings + ``num_encoder_units`` x (head, body, tail) + pooler +
     classification tail, each entry a dict with ``layer_type`` + ctor kwargs.
+
+    ``ffn_shards > 1`` replaces each ``BertLayer_Body`` with that many
+    :class:`BertLayer_BodyShard` units (numerically identical model,
+    finer allocation granularity — see the shard class docstring).
     """
     cfg = _cfg(config)
     # fresh dicts per entry: allocators may tag layer configs in place
+    if ffn_shards > 1:
+        def body_units():
+            return [
+                dict(layer_type="BertLayer_BodyShard", config=cfg.to_dict(),
+                     shard=k, num_shards=ffn_shards,
+                     deterministic=deterministic)
+                for k in range(ffn_shards)
+            ]
+    else:
+        def body_units():
+            return [dict(layer_type="BertLayer_Body", config=cfg.to_dict(),
+                         deterministic=deterministic)]
     encoder = [
-        dict(layer_type=t, config=cfg.to_dict(), deterministic=deterministic)
+        unit
         for _ in range(num_encoder_units)
-        for t in ("BertLayer_Head", "BertLayer_Body", "BertLayer_Tail")
+        for unit in (
+            [dict(layer_type="BertLayer_Head", config=cfg.to_dict(),
+                  deterministic=deterministic)]
+            + body_units()
+            + [dict(layer_type="BertLayer_Tail", config=cfg.to_dict(),
+                    deterministic=deterministic)]
+        )
     ]
     return (
         [dict(layer_type="BertEmbeddings", config=cfg.to_dict(),
@@ -329,6 +428,8 @@ __all__ = [
     "BertSelfOutput",
     "BertLayer_Head",
     "BertLayer_Body",
+    "BertLayer_BodyShard",
+    "split_body_params",
     "BertLayer_Tail",
     "BertPooler",
     "BertTailForClassification",

@@ -98,13 +98,16 @@ class PeerHeartbeat:
         runs INSIDE the watchdog window too — a peer that died before the
         first beat wedges the warm-up exactly like a regular beat.
         """
-        timer = threading.Timer(
-            self.timeout_s,
-            lambda: self._fail(
+        fired_this_beat = threading.Event()
+
+        def on_timeout():
+            fired_this_beat.set()
+            self._fail(
                 f"collective did not complete within {self.timeout_s}s "
                 f"(a peer process is dead or wedged)"
-            ),
-        )
+            )
+
+        timer = threading.Timer(self.timeout_s, on_timeout)
         timer.daemon = True
         start = time.perf_counter()
         timer.start()
@@ -119,8 +122,25 @@ class PeerHeartbeat:
         timer.cancel()
         self.last_beat_s = time.perf_counter() - start
         self.beats += 1
+        if fired_this_beat.is_set() and total == self._expected:
+            # THIS beat's watchdog fired but the collective then completed
+            # with the right sum — transient slowness (a one-off compile,
+            # a DCN hiccup), not a dead peer.  Clear the latch so one blip
+            # cannot permanently poison ``beat()``; ``on_failure`` has
+            # already fired once for the blip (and with
+            # ``abort_on_failure`` the process never reaches this line).
+            # A failure latched by a PREVIOUS beat (wrong sum, exception)
+            # is deliberately NOT cleared here — only the per-beat
+            # watchdog blip is recoverable.
+            self._logger.info(
+                "peer heartbeat recovered: collective completed after the "
+                f"watchdog fired ({self.last_beat_s:.1f}s > "
+                f"{self.timeout_s}s timeout)"
+            )
+            self.failed = False
+            return True
         if self.failed:
-            return False  # the timer fired before completion
+            return False  # a previous beat detected a real failure
         if total != self._expected:
             self._fail(
                 f"beat sum {total} != world size {self._expected} "

@@ -71,13 +71,15 @@ def _split_microbatches(tree, num_microbatches: int, what: str = "microbatches")
 # per-stage closures would defeat.  Sharing the compiled programs cuts
 # compile counts severalfold for the MPMD engine and the benchmark.
 #
-# The cache is process-global and pins jitted executables (plus the
-# optimizer object, so its id cannot be recycled); long-lived processes
-# building many models should call clear_program_cache() between
-# generations.  Sharing across models requires passing the SAME optimizer
+# The cache is process-global, bounded LRU (PROGRAM_CACHE_MAX_ENTRIES
+# slice structures; eviction releases the executables and the pinned
+# optimizer object, whose id is part of the key and therefore cannot be
+# recycled while cached).  clear_program_cache() still empties it
+# explicitly.  Sharing across models requires passing the SAME optimizer
 # object — two equal-hyperparameter optax objects have different ids and
 # do not share (optax transforms expose no reliable value-hash to key on).
 _PROGRAM_CACHE: Dict = {}
+PROGRAM_CACHE_MAX_ENTRIES = 64
 
 
 def clear_program_cache() -> None:
@@ -149,7 +151,11 @@ def get_stage_programs(layer_cfgs, optimizer) -> _StagePrograms:
         json.dumps(list(layer_cfgs), sort_keys=True, default=str),
         id(optimizer),
     )
-    if key not in _PROGRAM_CACHE:
+    if key in _PROGRAM_CACHE:
+        _PROGRAM_CACHE[key] = _PROGRAM_CACHE.pop(key)  # refresh LRU order
+    else:
+        while len(_PROGRAM_CACHE) >= PROGRAM_CACHE_MAX_ENTRIES:
+            _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
         _PROGRAM_CACHE[key] = _StagePrograms(layer_cfgs, optimizer)
     return _PROGRAM_CACHE[key]
 

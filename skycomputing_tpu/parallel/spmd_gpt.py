@@ -326,8 +326,13 @@ class CompiledGptPipeline(CompiledBertPipeline):
         hidden_mb = hidden.reshape(M, B // M, *hidden.shape[1:])
         # the ring schedule threads a per-microbatch side tensor; GPT needs
         # none, so ride a batch-shaped zero (batch-like so the dp sharding
-        # spec applies to it uniformly)
-        dummy_mb = jnp.zeros((M, B // M), hidden.dtype)
+        # spec applies to it uniformly).  MoE accumulates its Switch aux
+        # scalar into this tensor across every MoE layer — keep that
+        # accumulator float32 even under bf16 configs (it is tiny, [M, mb])
+        # so the load-balance loss does not lose precision to repeated
+        # bf16 rounding; dense stages keep hidden.dtype (pure placeholder).
+        side_dtype = jnp.float32 if self.side_outputs else hidden.dtype
+        dummy_mb = jnp.zeros((M, B // M), side_dtype)
 
         aux = None
         encoder = (self._interleaved_encoder if self.virtual_stages > 1

@@ -9,9 +9,11 @@ Megatron pairing falls out of the annotations: the FFN up-projection is
 column-sharded and the down-projection row-sharded, so the only
 communication per block is the all-reduce after the row-parallel matmuls.
 
-This path covers the monolithic (non-pipelined) model — TP inside the
-shard_map pipeline would need manual psums and is future work; combining
-TP with pipelining here means using this on each stage's sub-model.
+This path covers the monolithic (non-pipelined) model.  TP *inside* the
+shard_map pipeline — manual psums in the stage body — lives in
+:mod:`.spmd` (``TpEncoderStage`` / ``_TpDense``) and composes with the
+compiled GPipe/interleaved schedules there; this module remains the
+GSPMD-annotated alternative for monolithic models.
 """
 
 from __future__ import annotations

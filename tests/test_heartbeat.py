@@ -38,9 +38,16 @@ def test_beat_timeout_fires_watchdog(devices):
         return real_fn(x)
 
     hb._beat_fn = stalled
-    assert hb.beat() is False
-    assert hb.failed is True
+    # the watchdog fires mid-beat (on_failure sees the blip), but the
+    # collective then completes with the right sum — transient slowness
+    # clears the latch instead of permanently poisoning beat()
+    assert hb.beat() is True
+    assert hb.failed is False
     assert reasons and "did not complete" in reasons[0]
+    # a healthy follow-up beat stays healthy
+    hb._beat_fn = real_fn
+    assert hb.beat() is True
+    assert hb.failed is False
 
 
 def test_beat_exception_counts_as_detection(devices):

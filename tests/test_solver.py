@@ -176,3 +176,65 @@ def test_large_cluster_greedy_path():
     assert pos == L
     for d, (s, e) in zip(result.device_order, result.slices):
         assert sum(layer_mem[s:e]) <= device_mem[d] + 1e-9
+
+
+def test_lower_bound_sound_vs_exact(seed=None):
+    """The integral lower bound never exceeds the exact optimum."""
+    for seed in range(12):
+        rng = random.Random(seed)
+        L = rng.randint(4, 12)
+        D = rng.randint(2, 7)
+        layer_cost = [rng.uniform(0.3, 2.0) for _ in range(L)]
+        layer_mem = [rng.uniform(0.3, 2.0) for _ in range(L)]
+        device_time = [rng.uniform(0.5, 3.0) for _ in range(D)]
+        device_mem = [rng.uniform(2.0, 8.0) for _ in range(D)]
+        try:
+            res = solve_contiguous_minmax(
+                layer_cost, layer_mem, device_time, device_mem,
+                tolerance=1e-9, use_native=False,
+            )
+        except RuntimeError:
+            continue  # infeasible draw
+        assert res.lower_bound <= res.bottleneck * (1 + 1e-6), (
+            seed, res.lower_bound, res.bottleneck
+        )
+        assert res.lower_bound >= 0.0
+
+
+def test_lower_bound_certifies_uniform_instance():
+    """Uniform layers on integer-speed devices: floor-capacity argument
+    makes the bound tight, certifying the greedy's solution optimal."""
+    L, D = 40, 16
+    layer_cost = [1.0] * L
+    layer_mem = [1.0] * L
+    device_time = [1.0, 2.0, 3.0, 4.0] * 4
+    device_mem = [100.0] * D
+    res = solve_contiguous_minmax(
+        layer_cost, layer_mem, device_time, device_mem,
+        tolerance=1e-9, exact_limit=4, use_native=False,
+    )
+    assert res.lower_bound > 0
+    assert res.optimality_gap <= 1e-6
+
+
+def test_anneal_never_hurts_and_respects_bound():
+    """With the weakest greedy (1 attempt, no native), annealing must not
+    return anything worse, and nothing may beat the certified bound."""
+    rng = random.Random(3)
+    L, D = 60, 24
+    layer_cost = [rng.uniform(0.5, 2.0) for _ in range(L)]
+    layer_mem = [rng.uniform(0.5, 2.0) for _ in range(L)]
+    device_time = [rng.uniform(0.5, 4.0) for _ in range(D)]
+    device_mem = [rng.uniform(4.0, 9.0) for _ in range(D)]
+    base = solve_contiguous_minmax(
+        layer_cost, layer_mem, device_time, device_mem,
+        exact_limit=0, use_native=False, greedy_attempts=1,
+        anneal_seconds=0.0,
+    )
+    annealed = solve_contiguous_minmax(
+        layer_cost, layer_mem, device_time, device_mem,
+        exact_limit=0, use_native=False, greedy_attempts=1,
+        anneal_seconds=2.0,
+    )
+    assert annealed.bottleneck <= base.bottleneck * (1 + 1e-9)
+    assert annealed.bottleneck >= annealed.lower_bound * (1 - 1e-9)

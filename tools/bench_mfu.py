@@ -144,6 +144,30 @@ def main() -> int:
         f" | MFU {mfu * 100:.1f}%",
         flush=True,
     )
+    json_path = os.getenv("SKYTPU_MFU_JSON")
+    if json_path:
+        import json
+
+        with open(json_path, "w") as fh:
+            json.dump(
+                {
+                    "metric": (
+                        f"BERT-{preset} monolithic train-step MFU "
+                        f"(B={batch}, L={seq}) on {device.device_kind}"
+                    ),
+                    "value": round(mfu * 100, 2),
+                    "unit": "percent",
+                    "step_time_ms": round(best * 1e3, 3),
+                    "tflops_per_step": round(flops / 1e12, 3),
+                    "achieved_tflops_per_s": round(flops / best / 1e12, 2),
+                    "peak_tflops_per_s": round(peak / 1e12, 1),
+                    "device_kind": device.device_kind,
+                    "platform": device.platform,
+                },
+                fh,
+            )
+            fh.write("\n")
+        print(f"wrote {json_path}", flush=True)
 
     # one encoder stage (fwd+bwd) in isolation: the allocator's unit of time
     from skycomputing_tpu.parallel.spmd import EncoderStage
