@@ -266,8 +266,55 @@ def main() -> int:
         def memory_slowdown(self, rank):
             return float(mem_skew[rank])
 
+    def measure_current_allocation(wm, label, ps):
+        """Build the real pipeline for the CURRENT allocation, sanity-train
+        one step, measure raw per-stage times, and score the emulated
+        heterogeneous step time.  Worker slowdown fields are zeroed only
+        for the duration of the measurement (the schedule model applies
+        them to the measured times), then restored so a later
+        re-allocation still sees the heterogeneity config."""
+        saved = {}
+        stage_slowdowns = []
+        for w in sorted(wm.worker_pool, key=lambda w: w.rank):
+            if w.model_config:
+                stage_slowdowns.append(float(w.extra_config["slowdown"]))
+            saved[id(w)] = w.extra_config.get("slowdown", 1.0)
+            w.extra_config["slowdown"] = 1.0
+        try:
+            model = PipelineModel(
+                wm, ps, optax.sgd(1e-3), cross_entropy_loss, devices=devices
+            )
+            note(f"{label}: pipeline built ({len(model.stages)} stages); "
+                 f"running one sanity train step...")
+            # end-to-end sanity: the pipeline actually trains
+            loss = model.train_step(data, labels, rng=jax.random.key(0))
+            if not np.isfinite(loss):
+                raise RuntimeError(f"{label}: non-finite loss {loss}")
+            note(f"{label}: train step ok; measuring per-stage times...")
+            measured = model.measure_stage_times(data, repeats=repeats,
+                                                 inner_iters=2)
+        finally:
+            for w in wm.worker_pool:
+                w.extra_config["slowdown"] = saved[id(w)]
+        taus = [t * s for t, s in zip(measured, stage_slowdowns)]
+        step = schedule_step_time(taus, n_micro, sequential)
+        print(
+            f"# {label}: step={step:.4f}s loss={loss:.3f} layers="
+            f"{[len(w.model_config) for w in sorted(wm.worker_pool, key=lambda w: w.rank)]} "
+            f"measured={[round(t, 4) for t in measured]} "
+            f"slowdowns={stage_slowdowns}",
+            file=sys.stderr,
+        )
+        return step, measured
+
+    # closed-loop refinement: measure -> recalibrate per-layer costs ->
+    # re-solve (Allocator.refine_allocation), keeping the best emulated
+    # step time.  0 disables.
+    refine_iters = int(os.getenv("SKYTPU_BENCH_REFINE", "2"))
+
     step_times = {}
     solver_gap = None  # certified optimality gap of the optimal allocation
+    refine_history = []
     for alloc_type in ("even", "optimal"):
         wm = WorkerManager()
         wm.load_worker_pool_from_config(
@@ -301,48 +348,94 @@ def main() -> int:
         note(f"{alloc_type}: profiling devices + allocating...")
         if alloc_type == "even":
             allocator.even_allocate()
-        else:
-            allocator.optimal_allocate()
-            solver_gap = allocator.last_result.optimality_gap
+            note(f"{alloc_type}: allocation done")
+            step_times[alloc_type], _ = measure_current_allocation(
+                wm, alloc_type, ps
+            )
+            continue
+
+        def snapshot_allocation():
+            return [
+                (w, list(w.model_config or []), w.order, w.rank)
+                for w in wm.worker_pool
+            ]
+
+        def restore_allocation(snap):
+            for w, mc, order, rank in snap:
+                w.model_config = mc
+                w.order = order
+                w.rank = rank
+
+        allocator.optimal_allocate()
+        solver_gap = allocator.last_result.optimality_gap
         note(f"{alloc_type}: allocation done")
-
-        # the runtime slowdown sleep is for training emulation; disable it
-        # here — the schedule model applies slowdowns to measured times
-        stage_slowdowns = []
-        for w in sorted(wm.worker_pool, key=lambda w: w.rank):
-            if w.model_config:
-                stage_slowdowns.append(float(w.extra_config["slowdown"]))
-                w.extra_config["slowdown"] = 1.0
-
-        model = PipelineModel(
-            wm, ps, optax.sgd(1e-3), cross_entropy_loss, devices=devices
-        )
-        note(f"{alloc_type}: pipeline built ({len(model.stages)} stages); "
-             f"running one sanity train step...")
-
-        # end-to-end sanity: the pipeline actually trains
-        loss = model.train_step(data, labels, rng=jax.random.key(0))
-        if not np.isfinite(loss):
-            raise RuntimeError(f"{alloc_type}: non-finite loss {loss}")
-        note(f"{alloc_type}: train step ok; measuring per-stage times...")
-
-        measured = model.measure_stage_times(data, repeats=repeats,
-                                             inner_iters=2)
-        taus = [t * s for t, s in zip(measured, stage_slowdowns)]
-        step_times[alloc_type] = schedule_step_time(taus, n_micro, sequential)
-        print(
-            f"# {alloc_type}: step={step_times[alloc_type]:.4f}s "
-            f"loss={loss:.3f} layers="
-            f"{[len(w.model_config) for w in sorted(wm.worker_pool, key=lambda w: w.rank)]} "
-            f"measured={[round(t, 4) for t in measured]} "
-            f"slowdowns={stage_slowdowns}",
-            file=sys.stderr,
-        )
+        best_step, measured = measure_current_allocation(wm, alloc_type, ps)
+        best_gap, best_snap = solver_gap, snapshot_allocation()
+        refine_history.append(round(best_step, 4))
+        for it in range(1, refine_iters + 1):
+            # measured raw per-stage seconds calibrate the per-layer costs
+            # (slice-level fusion/cache effects the per-unit profile cannot
+            # see), then the solver re-runs on the calibrated instance
+            note(f"optimal: refine iteration {it}/{refine_iters} "
+                 f"(closed-loop re-solve on measured stage times)...")
+            allocator.refine_allocation(measured)
+            gap = allocator.last_result.optimality_gap
+            step, measured = measure_current_allocation(
+                wm, f"optimal+refine{it}", ps
+            )
+            refine_history.append(round(step, 4))
+            if step < best_step:
+                best_step, best_gap = step, gap
+                best_snap = snapshot_allocation()
+        if refine_iters > 0:
+            # SELECT on the (noisy) loop scores, but REPORT a fresh
+            # measurement of the selected allocation — taking the min of
+            # N draws for "optimal" while "even" gets one draw would bias
+            # the headline upward (winner's curse)
+            restore_allocation(best_snap)
+            final_step, _ = measure_current_allocation(
+                wm, "optimal-selected", ps
+            )
+            refine_history.append(round(final_step, 4))
+            step_times[alloc_type] = final_step
+        else:
+            step_times[alloc_type] = best_step
+        solver_gap = best_gap
 
     speedup_pct = (
         (step_times["even"] - step_times["optimal"]) / step_times["even"] * 100
     )
     mode = "sequential" if sequential else f"GPipe-M{n_micro}"
+
+    # ADVICE r03: the headline runs at ffn/2 granularity while vs_baseline
+    # divides by the reference's 55% measured at 1/3-encoder granularity.
+    # Record the ffn/1 number too (schedule model on the real timed ffn/1
+    # profile — same math evaluate_instance applies to the guard) so the
+    # baseline comparison can be read at matching granularity.
+    value_ffn1 = None
+    if os.getenv("SKYTPU_BENCH_EMIT_FFN1", "1") != "0" and ffn_shards != 1:
+        from skycomputing_tpu.dynamics.headline import evaluate_instance
+
+        note("ffn/1 reference-granularity number (schedule model on the "
+             "timed ffn/1 profile)...")
+        cfg_ffn1 = bert_layer_configs(
+            cfg, num_encoder_units=layer_num, num_classes=3,
+            deterministic=True, ffn_shards=1,
+        )
+        bench_ffn1 = ModelBenchmarker(
+            cfg_ffn1,
+            RandomTokenGenerator(batch_size=batch, seq_length=seq,
+                                 vocab_size=cfg.vocab_size),
+            timed=(profile_kind == "timed"),
+        )
+        c1, m1 = bench_ffn1.benchmark()
+        out1 = evaluate_instance(
+            c1, m1, slowdowns, num_microbatches=n_micro,
+            mem_budget_mb=mem_budget_mb, sequential=sequential,
+        )
+        value_ffn1 = round(out1["speedup_pct"], 2)
+        note(f"ffn/1 granularity: {value_ffn1}% "
+             f"(gap {out1['solver_result'].optimality_gap:.4f})")
     if platform != "cpu":
         _emit_mfu_artifact(note)
     print(
@@ -359,10 +452,19 @@ def main() -> int:
                 "value": round(speedup_pct, 2),
                 "unit": "percent",
                 "vs_baseline": round(speedup_pct / 55.0, 4),
+                # non-finite gap (lower bound <= 0) must serialize as null,
+                # not the invalid-JSON token Infinity
                 "solver_gap": (
                     round(solver_gap, 4) if solver_gap is not None
-                    and np.isfinite(solver_gap) else solver_gap
+                    and np.isfinite(solver_gap) else None
                 ),
+                # measured emulated step times per closed-loop iteration
+                # (optimal, then each refine_allocation re-solve)
+                "refine_steps": refine_history,
+                # reference-granularity (ffn/1) speedup via the schedule
+                # model on the timed ffn/1 profile — apples-to-apples with
+                # the reference's 1/3-encoder allocation units
+                "value_ffn1_model": value_ffn1,
                 "platform": platform,
                 "device_kind": devices[0].device_kind,
                 "probe_attempts": int(

@@ -124,7 +124,9 @@ class Allocator:
         return self._apply_partition(worker_ranks, ranges, orders)
 
     # ----------------------------------------------------- closed-loop refine
-    def refine_allocation(self, measured_stage_times) -> WorkerManager:
+    def refine_allocation(
+        self, measured_stage_times, damping: float = 0.5
+    ) -> WorkerManager:
         """Re-allocate with per-layer costs calibrated to MEASURED stage
         times — closed-loop allocation.
 
@@ -141,7 +143,12 @@ class Allocator:
         each round's slices change the slice-size effects being modeled.
 
         ``measured_stage_times`` are raw per-stage seconds, pipeline
-        order, one per worker with a non-empty slice.
+        order, one per worker with a non-empty slice.  ``damping``
+        exponentiates the per-stage correction (``scale**damping``):
+        a full-strength update (1.0) can oscillate between two
+        allocations — slice-level scales are applied uniformly to a
+        slice's layers, so re-solved boundaries re-mix them — while a
+        damped update contracts toward a fixed point.
         """
         base_costs, _ = self._model_benchmarker.benchmark()
         costs = list(
@@ -164,7 +171,7 @@ class Allocator:
             n = len(worker.model_config)
             pred = sum(costs[pos:pos + n])
             if pred > 0 and t > 0:
-                scale = float(t) / pred
+                scale = (float(t) / pred) ** float(damping)
                 costs[pos:pos + n] = [c * scale for c in costs[pos:pos + n]]
             pos += n
         if pos != len(costs):

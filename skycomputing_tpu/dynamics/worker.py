@@ -30,9 +30,15 @@ class Worker:
         model_config: Optional[List[Dict]] = None,
         extra_config: Optional[Dict[str, Any]] = None,
         is_running: bool = False,
+        stim_index: Optional[int] = None,
     ) -> None:
         self._rank = rank
         self._name = name
+        # stable heterogeneity-profile index: allocation re-ranks workers
+        # (``reset_rank_by_order``), so anything keyed by *current* rank —
+        # the Stimulator's per-worker slowdown draw — mis-attributes after
+        # the first allocate.  Freeze the identity at construction.
+        self._stim_index = stim_index if stim_index is not None else rank
         self._is_running = is_running
         self._order = order
         self._worker_id = worker_id if worker_id is not None else str(_uuid.uuid4())
@@ -58,6 +64,11 @@ class Worker:
     @property
     def name(self) -> str:
         return self._name
+
+    @property
+    def stim_index(self) -> int:
+        """Rank at construction — the stable key for heterogeneity draws."""
+        return self._stim_index
 
     # --- configs ------------------------------------------------------------
     @property

@@ -99,6 +99,7 @@ class PeerHeartbeat:
         first beat wedges the warm-up exactly like a regular beat.
         """
         fired_this_beat = threading.Event()
+        was_failed = self.failed
 
         def on_timeout():
             fired_this_beat.set()
@@ -122,7 +123,7 @@ class PeerHeartbeat:
         timer.cancel()
         self.last_beat_s = time.perf_counter() - start
         self.beats += 1
-        if fired_this_beat.is_set() and total == self._expected:
+        if not was_failed and fired_this_beat.is_set() and total == self._expected:
             # THIS beat's watchdog fired but the collective then completed
             # with the right sum — transient slowness (a one-off compile,
             # a DCN hiccup), not a dead peer.  Clear the latch so one blip
@@ -130,8 +131,9 @@ class PeerHeartbeat:
             # already fired once for the blip (and with
             # ``abort_on_failure`` the process never reaches this line).
             # A failure latched by a PREVIOUS beat (wrong sum, exception)
-            # is deliberately NOT cleared here — only the per-beat
-            # watchdog blip is recoverable.
+            # is deliberately NOT cleared: ``was_failed`` is snapshotted
+            # before the timer starts, so only the per-beat watchdog blip
+            # is recoverable.
             self._logger.info(
                 "peer heartbeat recovered: collective completed after the "
                 f"watchdog fired ({self.last_beat_s:.1f}s > "

@@ -50,6 +50,30 @@ def test_beat_timeout_fires_watchdog(devices):
     assert hb.failed is False
 
 
+def test_blip_recovery_does_not_erase_prior_real_failure(devices):
+    """A wrong-sum beat latches failed=True; a later slow-but-successful
+    beat (watchdog fires, sum correct) must NOT clear that latch — the
+    blip-recovery path only forgives the current beat's own watchdog."""
+    reasons = []
+    hb = PeerHeartbeat(timeout_s=0.05, on_failure=reasons.append)
+    hb._build()
+    real_fn = hb._beat_fn
+
+    import jax.numpy as jnp
+
+    hb._beat_fn = lambda x: jnp.asarray(hb._expected - 1.0)  # dropped peer
+    assert hb.beat() is False
+    assert hb.failed is True
+
+    def slow_but_correct(x):
+        time.sleep(0.3)
+        return real_fn(x)
+
+    hb._beat_fn = slow_but_correct
+    assert hb.beat() is False  # prior real failure must persist
+    assert hb.failed is True
+
+
 def test_beat_exception_counts_as_detection(devices):
     reasons = []
     hb = PeerHeartbeat(timeout_s=60.0, on_failure=reasons.append)

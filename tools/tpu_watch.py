@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Standing TPU-window watcher: seize the tunnel the moment it answers.
+
+The tunneled TPU backend in this environment flaps — rounds 1-3 never saw
+it answer (every ``jax.devices()`` probe hung; VERDICT r03 verified the
+wedge independently).  The hardware-measured artifact is still the biggest
+evidence hole, so this watcher polls cheaply in the background and, the
+moment a probe completes, runs the full evidence batch at the largest
+single-chip preset and leaves committed-ready artifacts:
+
+    MFU_r04.json     (tools/bench_mfu.py)
+    KV_r04.json      (tools/bench_kv_cache.py stdout capture)
+    BENCH_tpu_r04.json  (bench.py single JSON line)
+
+Every probe attempt is appended to ``logs/tpu_watch.jsonl`` either way —
+the probe log is itself the artifact proving the tunnel never answered
+(VERDICT r03 task #3 asks for exactly that on a dead tunnel).
+
+Usage:  python tools/tpu_watch.py [--once] [--interval 300] [--max-hours 11]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(ROOT, "logs", "tpu_watch.jsonl")
+
+PROBE_SNIPPET = (
+    "import jax, jax.numpy as jnp, json;"
+    "d = jax.devices();"
+    "jax.block_until_ready(jax.jit(lambda a: (a @ a).sum())"
+    "(jnp.ones((256, 256))));"
+    "print(json.dumps({'platform': d[0].platform,"
+    " 'device_kind': d[0].device_kind, 'n': len(d)}))"
+)
+
+
+def log_event(event: dict) -> None:
+    os.makedirs(os.path.dirname(LOG), exist_ok=True)
+    event = dict(event, ts=time.strftime("%Y-%m-%dT%H:%M:%S"))
+    with open(LOG, "a") as fh:
+        fh.write(json.dumps(event) + "\n")
+    print(f"# tpu_watch: {event}", file=sys.stderr, flush=True)
+
+
+def probe(timeout_s: float) -> dict | None:
+    """One subprocess probe; returns device info or None (hang/error)."""
+    t0 = time.time()
+    proc = subprocess.Popen(
+        [sys.executable, "-c", PROBE_SNIPPET],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        log_event({"probe": "hung", "timeout_s": timeout_s,
+                   "elapsed_s": round(time.time() - t0, 1)})
+        return None
+    if proc.returncode != 0:
+        log_event({"probe": "error", "rc": proc.returncode,
+                   "stderr_tail": err[-300:]})
+        return None
+    try:
+        info = json.loads(out.strip().splitlines()[-1])
+    except Exception:
+        log_event({"probe": "unparseable", "stdout_tail": out[-300:]})
+        return None
+    info["elapsed_s"] = round(time.time() - t0, 1)
+    log_event({"probe": "ok", **info})
+    return info
+
+
+def run_evidence_batch(info: dict) -> None:
+    """Tunnel is live: produce the hardware-measured artifacts."""
+    env = dict(os.environ)
+    runs = [
+        (
+            "mfu",
+            [sys.executable, os.path.join(ROOT, "tools", "bench_mfu.py")],
+            dict(env, SKYTPU_MFU_JSON=os.path.join(ROOT, "MFU_r04.json")),
+            3600,
+        ),
+        (
+            "kv_cache",
+            [sys.executable,
+             os.path.join(ROOT, "tools", "bench_kv_cache.py")],
+            env,
+            1800,
+        ),
+        (
+            "bench",
+            [sys.executable, os.path.join(ROOT, "bench.py")],
+            # no CPU fallback: if the tunnel flaps mid-batch the bench must
+            # fail, not silently record a CPU number as a "TPU" artifact
+            dict(env, SKYTPU_BENCH_EMIT_MFU="0",
+                 SKYTPU_BENCH_NO_FALLBACK="1"),
+            7200,
+        ),
+    ]
+    for name, cmd, run_env, budget in runs:
+        log_event({"run": name, "cmd": " ".join(cmd)})
+        try:
+            proc = subprocess.run(
+                cmd, env=run_env, timeout=budget, cwd=ROOT,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            )
+            tail = proc.stdout[-2000:]
+            log_event({"run": name, "rc": proc.returncode,
+                       "tail": tail})
+            if name == "kv_cache" and proc.returncode == 0:
+                with open(os.path.join(ROOT, "KV_r04.json"), "w") as fh:
+                    json.dump({"tool": "bench_kv_cache",
+                               "device": info, "stdout": proc.stdout}, fh,
+                              indent=2)
+            if name == "bench" and proc.returncode == 0:
+                last = [ln for ln in proc.stdout.splitlines()
+                        if ln.startswith("{")]
+                record = None
+                try:
+                    record = json.loads(last[-1]) if last else None
+                except ValueError:
+                    pass
+                if record and record.get("platform") not in (None, "cpu"):
+                    with open(os.path.join(ROOT, "BENCH_tpu_r04.json"),
+                              "w") as fh:
+                        fh.write(last[-1] + "\n")
+                else:
+                    log_event({"run": name, "note":
+                               "bench output was not TPU-measured; "
+                               "artifact NOT written"})
+        except subprocess.TimeoutExpired:
+            log_event({"run": name, "rc": "timeout", "budget_s": budget})
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--once", action="store_true",
+                    help="single probe, no loop")
+    ap.add_argument("--interval", type=float, default=300.0)
+    ap.add_argument("--probe-timeout", type=float, default=150.0)
+    ap.add_argument("--max-hours", type=float, default=11.0)
+    args = ap.parse_args()
+
+    deadline = time.time() + args.max_hours * 3600
+    log_event({"watcher": "start", "interval_s": args.interval,
+               "probe_timeout_s": args.probe_timeout,
+               "max_hours": args.max_hours})
+    while True:
+        info = probe(args.probe_timeout)
+        if info is not None and info.get("platform") != "cpu":
+            run_evidence_batch(info)
+            log_event({"watcher": "evidence batch complete"})
+            return 0
+        if info is not None:
+            # backend answered but it's CPU — no tunnel to seize
+            log_event({"watcher": "backend is cpu; nothing to seize"})
+            return 1
+        if args.once or time.time() > deadline:
+            log_event({"watcher": "giving up", "reason":
+                       "once" if args.once else "max-hours reached"})
+            return 2
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
