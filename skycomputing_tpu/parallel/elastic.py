@@ -1,0 +1,282 @@
+"""Elastic re-formation: survivors re-form the world after a peer dies.
+
+Completes what the reference only scaffolds
+(``scaelum/dynamics/worker_manager.py:46-60`` — add/remove-worker with no
+recovery wired to it).  Detection already exists here
+(:class:`~.heartbeat.PeerHeartbeat`, the runtime's coordination service);
+this module adds the RECOVERY half: after a failure, the surviving nodes
+agree on a new, smaller world and resume training from the last
+checkpoint.
+
+Why supervisors, not in-process re-initialization
+-------------------------------------------------
+Under ``jax.distributed`` a dead peer is unrecoverable *inside* the
+surviving process: the coordination service propagates the failure by
+FATAL-ing every healthy task from its error-polling thread (verified on
+jax 0.9.0 — an ``absl`` check failure, not a Python exception), and
+``jax.distributed.initialize`` may be called exactly once per process.
+Recovery therefore has to happen one level up, exactly like torchelastic /
+elastic Horovod: a lightweight per-node **supervisor** launches the
+trainer, watches for abnormal exit (peer-death fatal, heartbeat abort
+rc=17), re-rendezvouses with the other surviving supervisors, and
+relaunches the trainer in a generation-(g+1) world whose coordinator and
+membership come from the rendezvous.  Checkpoints are partition- AND
+world-size-independent (layer-indexed; ``tests/test_resume.py``), so the
+relaunched trainer resumes exactly.
+
+Rendezvous is a shared directory — the same substrate the reference
+already leaned on for cross-process coordination (its file-based
+``DistributedTimer``, ``scaelum/timer/timer.py``), so a Slurm cluster or a
+single CI host both work with no extra service:
+
+    nodes/<node_id>.alive     mtime-refreshed liveness beacons
+    gen_<g>/world.json        the coordinator's world spec for generation g
+
+Protocol per re-formation round: every surviving supervisor refreshes its
+beacon and waits ``settle_s``; the membership is every node whose beacon
+is fresher than ``stale_s``; the member with the LOWEST node id becomes
+coordinator, binds a free port, and publishes ``world.json``; everyone
+else polls for it, finds its rank by position, and relaunches its trainer
+with ``SKYTPU_COORDINATOR``/``SKYTPU_NUM_PROCESSES``/``SKYTPU_PROCESS_ID``
+(the exact env :func:`~.multihost.initialize_from_env` consumes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..utils.logger import Logger
+
+#: trainer exit codes the supervisor treats as "peer failure — re-form":
+#: 17 is HeartbeatHook's abort code; nonzero anything else is a crash
+#: (coordination-service FATALs exit with the abort signal's code).
+HEARTBEAT_ABORT_RC = 17
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _routable_host() -> str:
+    """Address other nodes can reach this one at, for world.json.
+
+    ``SKYTPU_ELASTIC_HOST`` overrides (multi-NIC clusters pin their data
+    interface the way the reference pinned ``GLOO_SOCKET_IFNAME``,
+    ``/root/reference/experiment/config.py:53-55``); otherwise the
+    hostname's resolved address, falling back to loopback for
+    single-machine worlds.
+    """
+    override = os.environ.get("SKYTPU_ELASTIC_HOST")
+    if override:
+        return override
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+class FileRendezvous:
+    """Shared-directory membership + world agreement (see module doc)."""
+
+    def __init__(self, root: str, node_id: int, stale_s: float = 6.0,
+                 settle_s: float = 2.0, timeout_s: float = 120.0):
+        self.root = root
+        self.node_id = int(node_id)
+        self.stale_s = float(stale_s)
+        self.settle_s = float(settle_s)
+        self.timeout_s = float(timeout_s)
+        os.makedirs(os.path.join(root, "nodes"), exist_ok=True)
+
+    # --- liveness beacons -------------------------------------------------
+    @property
+    def _beacon(self) -> str:
+        return os.path.join(self.root, "nodes", f"{self.node_id}.alive")
+
+    def refresh_beacon(self) -> None:
+        with open(self._beacon, "w") as fh:
+            fh.write(str(time.time()))
+
+    def alive_nodes(self) -> List[int]:
+        """Node ids whose beacons are fresher than ``stale_s``."""
+        out = []
+        now = time.time()
+        ndir = os.path.join(self.root, "nodes")
+        for name in os.listdir(ndir):
+            if not name.endswith(".alive"):
+                continue
+            path = os.path.join(ndir, name)
+            try:
+                age = now - os.stat(path).st_mtime
+            except OSError:
+                continue
+            if age <= self.stale_s:
+                out.append(int(name[: -len(".alive")]))
+        return sorted(out)
+
+    # --- world agreement --------------------------------------------------
+    def _world_path(self, generation: int) -> str:
+        return os.path.join(self.root, f"gen_{generation}", "world.json")
+
+    def form_world(self, generation: int,
+                   expect: Optional[int] = None) -> Dict:
+        """Agree on generation ``generation``'s world; returns its spec.
+
+        ``expect``: for the initial formation, wait until that many nodes
+        are alive (later generations take whoever is still beating).
+        Returns ``{"coordinator": addr, "members": [...], "generation": g}``
+        with this node guaranteed to be a member (else RuntimeError — the
+        cluster moved on without us).
+        """
+        deadline = time.monotonic() + self.timeout_s
+        self.refresh_beacon()
+        if expect is not None:
+            while len(self.alive_nodes()) < expect:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"only {self.alive_nodes()} of {expect} nodes "
+                        f"arrived within {self.timeout_s}s"
+                    )
+                self.refresh_beacon()
+                time.sleep(0.2)
+        else:
+            # settle: let every survivor notice the failure and beat again
+            settle_end = time.monotonic() + self.settle_s
+            while time.monotonic() < settle_end:
+                self.refresh_beacon()
+                time.sleep(0.2)
+
+        members = self.alive_nodes()
+        if self.node_id not in members:
+            raise RuntimeError(
+                f"node {self.node_id} not in membership {members}"
+            )
+        path = self._world_path(generation)
+        if members[0] == self.node_id:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            spec = dict(
+                coordinator=f"{_routable_host()}:{_free_port()}",
+                members=members,
+                generation=generation,
+            )
+            tmp = path + f".tmp{self.node_id}"
+            with open(tmp, "w") as fh:
+                json.dump(spec, fh)
+            os.replace(tmp, path)  # atomic publish
+            return spec
+        while True:
+            if os.path.exists(path):
+                with open(path) as fh:
+                    spec = json.load(fh)
+                if self.node_id not in spec["members"]:
+                    raise RuntimeError(
+                        f"node {self.node_id} excluded from generation "
+                        f"{generation}: {spec['members']}"
+                    )
+                return spec
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no world.json for generation {generation} within "
+                    f"{self.timeout_s}s"
+                )
+            self.refresh_beacon()
+            time.sleep(0.2)
+
+
+class ElasticSupervisor:
+    """Per-node trainer babysitter: form -> launch -> watch -> re-form.
+
+    ``trainer_cmd(spec, rank)`` returns the argv for this node's trainer
+    given the world spec and this node's rank in it; the supervisor adds
+    the ``SKYTPU_*`` world env.  The trainer must exit 0 when training is
+    complete; any abnormal exit triggers a re-formation round (up to
+    ``max_reforms``), shrinking to whoever still runs a supervisor.
+    """
+
+    def __init__(
+        self,
+        node_id: int,
+        rendezvous_dir: str,
+        trainer_cmd: Callable[[Dict, int], Sequence[str]],
+        expect: int,
+        max_reforms: int = 3,
+        env: Optional[Dict[str, str]] = None,
+        logger: Optional[Logger] = None,
+        stale_s: float = 6.0,
+        settle_s: float = 2.0,
+        timeout_s: float = 120.0,
+    ):
+        self.node_id = int(node_id)
+        self.rdv = FileRendezvous(rendezvous_dir, node_id, stale_s=stale_s,
+                                  settle_s=settle_s, timeout_s=timeout_s)
+        self._trainer_cmd = trainer_cmd
+        self._expect = int(expect)
+        self._max_reforms = int(max_reforms)
+        self._env = dict(env) if env is not None else dict(os.environ)
+        self._logger = logger or Logger()
+        self.generations: List[Dict] = []
+
+    def _launch(self, spec: Dict) -> subprocess.Popen:
+        rank = spec["members"].index(self.node_id)
+        env = dict(self._env)
+        env["SKYTPU_COORDINATOR"] = spec["coordinator"]
+        env["SKYTPU_NUM_PROCESSES"] = str(len(spec["members"]))
+        env["SKYTPU_PROCESS_ID"] = str(rank)
+        env["SKYTPU_GENERATION"] = str(spec["generation"])
+        # fast dead-peer detection so a lost node surfaces as a trainer
+        # exit within seconds, not the 100 s default
+        env.setdefault(
+            "JAX_COORDINATION_SERVICE_HEARTBEAT_TIMEOUT_SECONDS", "10"
+        )
+        cmd = list(self._trainer_cmd(spec, rank))
+        self._logger.info(
+            f"[node {self.node_id}] gen {spec['generation']}: rank {rank}/"
+            f"{len(spec['members'])} coordinator {spec['coordinator']}"
+        )
+        return subprocess.Popen(cmd, env=env)
+
+    def run(self) -> int:
+        """Supervise until the trainer completes (rc 0) or re-forms are
+        exhausted.  Returns the final trainer exit code."""
+        generation = 0
+        spec = self.rdv.form_world(0, expect=self._expect)
+        self.generations.append(spec)
+        reforms = 0
+        while True:
+            proc = self._launch(spec)
+            while True:
+                try:
+                    rc = proc.wait(timeout=1.0)
+                    break
+                except subprocess.TimeoutExpired:
+                    self.rdv.refresh_beacon()
+            if rc == 0:
+                self._logger.info(
+                    f"[node {self.node_id}] trainer complete "
+                    f"(generation {spec['generation']})"
+                )
+                return 0
+            if reforms >= self._max_reforms:
+                self._logger.info(
+                    f"[node {self.node_id}] giving up after {reforms} "
+                    f"re-formations (rc={rc})"
+                )
+                return rc
+            reforms += 1
+            generation += 1
+            self._logger.info(
+                f"[node {self.node_id}] trainer exited rc={rc} "
+                f"(peer failure); re-forming as generation {generation}"
+            )
+            spec = self.rdv.form_world(generation)
+            self.generations.append(spec)
+
+
+__all__ = ["ElasticSupervisor", "FileRendezvous", "HEARTBEAT_ABORT_RC"]

@@ -55,18 +55,19 @@ class EncoderUnit(nn.Module):
     """One full encoder trio (attention + FFN)."""
 
     config: Any
+    deterministic: bool = True
 
     @nn.compact
     def __call__(self, hidden, mask):
-        hidden, mask = BertLayer_Head(self.config, True, name="head")(
-            hidden, mask
-        )
-        inter, attn, mask = BertLayer_Body(self.config, True, name="body")(
-            hidden, mask
-        )
-        hidden, mask = BertLayer_Tail(self.config, True, name="tail")(
-            inter, attn, mask
-        )
+        hidden, mask = BertLayer_Head(
+            self.config, self.deterministic, name="head"
+        )(hidden, mask)
+        inter, attn, mask = BertLayer_Body(
+            self.config, self.deterministic, name="body"
+        )(hidden, mask)
+        hidden, mask = BertLayer_Tail(
+            self.config, self.deterministic, name="tail"
+        )(inter, attn, mask)
         return hidden, mask
 
 
@@ -82,12 +83,13 @@ class EncoderStage(nn.Module):
 
     config: Any
     units: int
+    deterministic: bool = True
 
     @nn.compact
     def __call__(self, hidden, mask):
         for u in range(self.units):
             hidden, mask = nn.remat(EncoderUnit)(
-                self.config, name=f"unit_{u}"
+                self.config, self.deterministic, name=f"unit_{u}"
             )(hidden, mask)
         return hidden, mask
 
@@ -129,13 +131,21 @@ class TpEncoderUnit(nn.Module):
     attention output projection and the FFN down-projection are
     row-parallel with a ``psum``; LayerNorms and residuals are replicated.
     Param tree mirrors :class:`EncoderUnit` (``head/self/query`` etc.) with
-    tp-local leaf shapes.  Deterministic only (the compiled pipeline body
-    never applies dropout).
+    tp-local leaf shapes.
+
+    Dropout (``deterministic=False``) follows Megatron RNG discipline: the
+    dropouts on REPLICATED activations (attention output, FFN output —
+    both after the row-parallel psum) draw from the shared per-tick key,
+    so every tp rank applies the identical mask and replicas stay equal;
+    the attention-probs dropout acts on head-SHARDED activations and is
+    desynchronized across tp by folding ``lax.axis_index('tp')`` into its
+    key (independent masks per head shard).
     """
 
     config: Any
     tp: int
     axis_name: str = "tp"
+    deterministic: bool = True
 
     @nn.compact
     def __call__(self, hidden, mask):
@@ -156,6 +166,8 @@ class TpEncoderUnit(nn.Module):
         head_dim = cfg.hidden_size // cfg.num_attention_heads
         h_local = cfg.hidden_size // self.tp
         i_local = cfg.intermediate_size // self.tp
+        deterministic = self.deterministic
+        tp_axis = self.axis_name
 
         class Head(nn.Module):
             @nn.compact
@@ -164,7 +176,7 @@ class TpEncoderUnit(nn.Module):
                     @nn.compact
                     def __call__(sf2, x, mask):
                         mk = lambda nm: _TpDense(
-                            h_local, dtype, "col", self.axis_name, name=nm
+                            h_local, dtype, "col", tp_axis, name=nm
                         )
                         split = lambda t: t.reshape(
                             t.shape[0], t.shape[1], n_heads, head_dim
@@ -179,6 +191,18 @@ class TpEncoderUnit(nn.Module):
                         probs = jax.nn.softmax(
                             scores.astype(jnp.float32), axis=-1
                         ).astype(dtype)
+                        if (
+                            not deterministic
+                            and cfg.attention_probs_dropout_prob > 0.0
+                        ):
+                            # head-sharded region: desync masks across tp
+                            rng = jax.random.fold_in(
+                                sf2.make_rng("dropout"),
+                                lax.axis_index(tp_axis),
+                            )
+                            probs = nn.Dropout(
+                                cfg.attention_probs_dropout_prob
+                            )(probs, deterministic=False, rng=rng)
                         ctx = jnp.einsum("bhlm,bmhd->blhd", probs, v)
                         return ctx.reshape(ctx.shape[0], ctx.shape[1],
                                            h_local)
@@ -187,7 +211,12 @@ class TpEncoderUnit(nn.Module):
                     @nn.compact
                     def __call__(sf2, ctx, residual):
                         y = _TpDense(cfg.hidden_size, dtype, "row",
-                                     self.axis_name, name="dense")(ctx)
+                                     tp_axis, name="dense")(ctx)
+                        # replicated region (post-psum): shared key ->
+                        # identical mask on every tp rank
+                        y = nn.Dropout(cfg.hidden_dropout_prob)(
+                            y, deterministic=deterministic
+                        )
                         out = nn.LayerNorm(
                             epsilon=1e-12, dtype=jnp.float32,
                             name="LayerNorm",
@@ -201,15 +230,18 @@ class TpEncoderUnit(nn.Module):
             @nn.compact
             def __call__(sf, attn_out, mask):
                 act = ACT2FN[cfg.hidden_act]
-                inter = act(_TpDense(i_local, dtype, "col", self.axis_name,
+                inter = act(_TpDense(i_local, dtype, "col", tp_axis,
                                      name="dense_act")(attn_out))
                 return inter, attn_out, mask
 
         class Tail(nn.Module):
             @nn.compact
             def __call__(sf, inter, attn_out, mask):
-                y = _TpDense(cfg.hidden_size, dtype, "row", self.axis_name,
+                y = _TpDense(cfg.hidden_size, dtype, "row", tp_axis,
                              name="dense")(inter)
+                y = nn.Dropout(cfg.hidden_dropout_prob)(
+                    y, deterministic=deterministic
+                )
                 out = nn.LayerNorm(
                     epsilon=1e-12, dtype=jnp.float32, name="LayerNorm"
                 )(y + attn_out)
@@ -227,12 +259,14 @@ class TpEncoderStage(nn.Module):
     units: int
     tp: int
     axis_name: str = "tp"
+    deterministic: bool = True
 
     @nn.compact
     def __call__(self, hidden, mask):
         for u in range(self.units):
             hidden, mask = nn.remat(TpEncoderUnit)(
-                self.config, self.tp, self.axis_name, name=f"unit_{u}"
+                self.config, self.tp, self.axis_name, self.deterministic,
+                name=f"unit_{u}",
             )(hidden, mask)
         return hidden, mask
 
@@ -368,7 +402,15 @@ class CompiledBertPipeline:
         zero1: bool = False,
         zero2: bool = False,
         zero3: bool = False,
+        deterministic: bool = True,
     ):
+        # deterministic=False enables dropout end to end (the reference
+        # fine-tunes with dropout throughout,
+        # scaelum/model/bert_layers.py): replicated ends use plain flax
+        # rngs, the pipelined body threads a threefry key through the ring
+        # scan folded by (device, tick) — every (stage, tick, microbatch)
+        # cell draws an independent mask, reproducible per seed.
+        self.deterministic = bool(deterministic)
         self.cfg = self._parse_config(config)
         self.mesh = mesh
         self.num_stages = int(mesh.shape["pp"])
@@ -441,18 +483,21 @@ class CompiledBertPipeline:
     def _build_modules(self, units_per_stage: int, num_classes: int) -> None:
         """Model-specific module construction (overridden per family)."""
         cfg_dict = self.cfg.to_dict()
-        self.embeddings = BertEmbeddings(cfg_dict, deterministic=True)
-        self.stage = EncoderStage(cfg_dict, units_per_stage)
+        det = self.deterministic
+        self.embeddings = BertEmbeddings(cfg_dict, deterministic=det)
+        self.stage = EncoderStage(cfg_dict, units_per_stage,
+                                  deterministic=det)
         self.tp_stage = (
-            TpEncoderStage(cfg_dict, units_per_stage, self.tp)
+            TpEncoderStage(cfg_dict, units_per_stage, self.tp,
+                           deterministic=det)
             if self.tp > 1 else None
         )
-        self.pooler = BertPooler(cfg_dict, deterministic=True)
+        self.pooler = BertPooler(cfg_dict, deterministic=det)
         self.classifier = BertTailForClassification(
             hidden_dropout_prob=self.cfg.hidden_dropout_prob,
             hidden_size=self.cfg.hidden_size,
             num_classes=num_classes,
-            deterministic=True,
+            deterministic=det,
             dtype=self.cfg.dtype,
         )
 
@@ -515,15 +560,26 @@ class CompiledBertPipeline:
     def init(self, rng: jax.Array, input_ids, token_type_ids, attention_mask):
         """Initialize params: stage params stacked on a leading 'pp' axis."""
         k_embed, k_stage, k_pool, k_cls = jax.random.split(rng, 4)
+        # stochastic modules consume a 'dropout' stream during their init
+        # forward; masks don't create params, so the tree is identical to
+        # the deterministic engine's
+        drop = (
+            {} if self.deterministic
+            else {"dropout": jax.random.fold_in(rng, 99)}
+        )
         embed_vars = self.embeddings.init(
-            {"params": k_embed}, input_ids, token_type_ids, attention_mask
+            {"params": k_embed, **drop},
+            input_ids, token_type_ids, attention_mask,
         )
         hidden, mask4 = self.embeddings.apply(
-            embed_vars, input_ids, token_type_ids, attention_mask
+            embed_vars, input_ids, token_type_ids, attention_mask,
+            rngs=drop or None,
         )
 
         def init_one_stage(key):
-            return self.stage.init({"params": key}, hidden, mask4)["params"]
+            return self.stage.init(
+                {"params": key, **drop}, hidden, mask4
+            )["params"]
 
         S, V = self.num_stages, self.virtual_stages
         chunk_keys = jax.random.split(k_stage, S * V)
@@ -538,9 +594,12 @@ class CompiledBertPipeline:
                 stages, self.tp, self.tp_col_modules, self.tp_row_modules
             )
 
-        pooler_vars = self.pooler.init({"params": k_pool}, hidden, mask4)
-        pooled = self.pooler.apply(pooler_vars, hidden, mask4)
-        cls_vars = self.classifier.init({"params": k_cls}, pooled)
+        pooler_vars = self.pooler.init(
+            {"params": k_pool, **drop}, hidden, mask4
+        )
+        pooled = self.pooler.apply(pooler_vars, hidden, mask4,
+                                   rngs=drop or None)
+        cls_vars = self.classifier.init({"params": k_cls, **drop}, pooled)
 
         params = {
             "embeddings": embed_vars["params"],
@@ -599,16 +658,22 @@ class CompiledBertPipeline:
     side_outputs = False
 
     # --- the pipelined encoder ----------------------------------------------
-    def _run_ring_schedule(self, body, stage_params, hidden_mb, mask_mb):
+    def _run_ring_schedule(self, body, stage_params, hidden_mb, mask_mb,
+                           rng=None):
         """Shared shard_map scaffolding for both pipeline schedules.
 
-        ``body(local_stage_params, hidden_mb, mask_mb) -> [M, ...]`` runs
-        per device; activations keep their optional dp sharding, outputs
-        stack per-stage buffers along axis 0 and only the last device's
-        block (the final stage/chunk) is meaningful.  With
-        ``side_outputs`` the body returns a (hidden, side) buffer pair.
-        M comes from the input's leading axis (the padded count when the
-        grouped schedule padded up to a multiple of S).
+        ``body(local_stage_params, hidden_mb, mask_mb[, rng_data]) ->
+        [M, ...]`` runs per device; activations keep their optional dp
+        sharding, outputs stack per-stage buffers along axis 0 and only
+        the last device's block (the final stage/chunk) is meaningful.
+        With ``side_outputs`` the body returns a (hidden, side) buffer
+        pair.  M comes from the input's leading axis (the padded count
+        when the grouped schedule padded up to a multiple of S).
+
+        ``rng`` (a jax PRNG key; stochastic engines only) enters the body
+        as replicated raw key data — every device derives its own stream
+        by folding in its mesh position, so no per-device key plumbing is
+        needed at the call site.
         """
         M = hidden_mb.shape[0]
         act_spec = P(None, "dp") if self.dp > 1 else P()
@@ -618,16 +683,44 @@ class CompiledBertPipeline:
             self._stage_in_specs if self._stage_in_specs is not None
             else self._stage_spec
         )
+        in_specs = [stage_specs, act_spec, act_spec]
+        args = [stage_params, hidden_mb, mask_mb]
+        if rng is not None:
+            in_specs.append(P())
+            args.append(jax.random.key_data(rng))
         out = jax.shard_map(
             body,
             mesh=self.mesh,
-            in_specs=(stage_specs, act_spec, act_spec),
+            in_specs=tuple(in_specs),
             out_specs=out_specs,
             check_vma=False,
-        )(stage_params, hidden_mb, mask_mb)
+        )(*args)
         if self.side_outputs:
             return out[0][-M:], out[1][-M:]
         return out[-M:]
+
+    def _stage_rng_stream(self, maybe_rng):
+        """Per-device dropout-key base + per-tick rngs-dict factory.
+
+        ``maybe_rng`` is the body's trailing varargs: empty for the
+        deterministic engine, else one raw-key-data array.  The base key
+        folds in the device's 'pp' position; each tick t folds again, so
+        every (device, tick) cell — hence every (stage/chunk, microbatch)
+        pair — draws an independent, reproducible mask.
+        """
+        if not maybe_rng:
+            return lambda t: {}
+        base = jax.random.fold_in(
+            jax.random.wrap_key_data(maybe_rng[0]), lax.axis_index("pp")
+        )
+        if self.dp > 1:
+            # data-parallel shards hold different rows; desync their masks
+            # (tp deliberately NOT folded — replicated-region masks must
+            # match across tp, see TpEncoderUnit)
+            base = jax.random.fold_in(base, lax.axis_index("dp"))
+        return lambda t: {
+            "rngs": {"dropout": jax.random.fold_in(base, t)}
+        }
 
     def _guard_tp_replicated(self, local_stage_params):
         """Wrap tp-replicated leaves so their gradient sums across tp."""
@@ -660,14 +753,15 @@ class CompiledBertPipeline:
             jax.tree_util.tree_map(index_chunk, local_stage_params)
         )
 
-    def _pipelined_encoder(self, stage_params, hidden_mb, mask_mb):
+    def _pipelined_encoder(self, stage_params, hidden_mb, mask_mb,
+                           rng=None):
         """shard_map GPipe: [M, mb, L, H] -> [M, mb, L, H]."""
         S = self.num_stages
         M = hidden_mb.shape[0]
         tp = self.tp
         stage_mod = self.tp_stage if tp > 1 else self.stage
 
-        def body(local_stage_params, hidden_mb, mask_mb):
+        def body(local_stage_params, hidden_mb, mask_mb, *maybe_rng):
             # local leaves have leading dim 1 (this device's stage); with
             # tensor parallelism a second singleton tp-shard dim follows
             params = jax.tree_util.tree_map(
@@ -678,6 +772,7 @@ class CompiledBertPipeline:
             params = self._guard_tp_replicated(params)
             idx = lax.axis_index("pp")
             fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+            tick_rngs = self._stage_rng_stream(maybe_rng)
 
             if self.side_outputs:
                 # the side is a per-microbatch accumulator (e.g. MoE aux
@@ -694,7 +789,9 @@ class CompiledBertPipeline:
                     feed = jnp.clip(t, 0, M - 1)
                     inp_h = jnp.where(idx == 0, hidden_mb[feed], recv_h)
                     inp_s = jnp.where(idx == 0, mask_mb[feed], recv_s)
-                    h, s = stage_mod.apply({"params": params}, inp_h, inp_s)
+                    h, s = stage_mod.apply(
+                        {"params": params}, inp_h, inp_s, **tick_rngs(t)
+                    )
                     w = jnp.clip(t - (S - 1), 0, M - 1)
                     out_h = lax.dynamic_update_index_in_dim(out_h, h, w, 0)
                     out_s = lax.dynamic_update_index_in_dim(out_s, s, w, 0)
@@ -715,7 +812,8 @@ class CompiledBertPipeline:
                 inp = jnp.where(idx == 0, feed, recv)
                 mb_idx = jnp.clip(t - idx, 0, M - 1)
                 out, _ = stage_mod.apply(
-                    {"params": params}, inp, mask_mb[mb_idx]
+                    {"params": params}, inp, mask_mb[mb_idx],
+                    **tick_rngs(t),
                 )
                 # last stage records its finished microbatch; earlier
                 # (bubble) writes land on index 0 and are overwritten at
@@ -731,9 +829,11 @@ class CompiledBertPipeline:
             )
             return outputs
 
-        return self._run_ring_schedule(body, stage_params, hidden_mb, mask_mb)
+        return self._run_ring_schedule(body, stage_params, hidden_mb,
+                                       mask_mb, rng=rng)
 
-    def _interleaved_encoder(self, stage_params, hidden_mb, mask_mb):
+    def _interleaved_encoder(self, stage_params, hidden_mb, mask_mb,
+                             rng=None):
         """V>1 chunk-wavefront schedule: [M, mb, L, H] -> [M, mb, L, H].
 
         Chunk c (device c mod S, local slot c // S) processes microbatch m
@@ -757,13 +857,13 @@ class CompiledBertPipeline:
                     [t, jnp.zeros((pad,) + t.shape[1:], t.dtype)], axis=0
                 )
                 out = self._interleaved_grouped_encoder(
-                    stage_params, zeros(hidden_mb), zeros(mask_mb)
+                    stage_params, zeros(hidden_mb), zeros(mask_mb), rng=rng
                 )
                 if self.side_outputs:
                     return out[0][:M], out[1][:M]
                 return out[:M]
             return self._interleaved_grouped_encoder(
-                stage_params, hidden_mb, mask_mb
+                stage_params, hidden_mb, mask_mb, rng=rng
             )
         V = self.virtual_stages
         C = S * V
@@ -771,10 +871,11 @@ class CompiledBertPipeline:
         tp = self.tp
         stage_mod = self.tp_stage if tp > 1 else self.stage
 
-        def body(local_stage_params, hidden_mb, mask_mb):
+        def body(local_stage_params, hidden_mb, mask_mb, *maybe_rng):
             local_stage_params = self._guard_tp_replicated(local_stage_params)
             d = lax.axis_index("pp")
             fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+            tick_rngs = self._stage_rng_stream(maybe_rng)
 
             def tick_coords(t):
                 """t -> (chunk slot k_c, microbatch m_c, write index w)."""
@@ -804,7 +905,7 @@ class CompiledBertPipeline:
                     inp_h = jnp.where(first, hidden_mb[m_c], recv_h)
                     inp_s = jnp.where(first, mask_mb[m_c], recv_s)
                     h, s = stage_mod.apply(
-                        {"params": params_k}, inp_h, inp_s
+                        {"params": params_k}, inp_h, inp_s, **tick_rngs(t)
                     )
                     out_h = lax.dynamic_update_index_in_dim(out_h, h, w, 0)
                     out_s = lax.dynamic_update_index_in_dim(out_s, s, w, 0)
@@ -827,7 +928,7 @@ class CompiledBertPipeline:
                 is_first_chunk = (d == 0) & (k_c == 0)
                 inp = jnp.where(is_first_chunk, hidden_mb[m_c], recv)
                 out, _ = stage_mod.apply(
-                    {"params": params_k}, inp, mask_mb[m_c]
+                    {"params": params_k}, inp, mask_mb[m_c], **tick_rngs(t)
                 )
                 # idle ticks (bubble) compute on clamped inputs; their
                 # outputs are never consumed by an active receiver, and
@@ -842,9 +943,11 @@ class CompiledBertPipeline:
             )
             return outputs
 
-        return self._run_ring_schedule(body, stage_params, hidden_mb, mask_mb)
+        return self._run_ring_schedule(body, stage_params, hidden_mb,
+                                       mask_mb, rng=rng)
 
-    def _interleaved_grouped_encoder(self, stage_params, hidden_mb, mask_mb):
+    def _interleaved_grouped_encoder(self, stage_params, hidden_mb, mask_mb,
+                                     rng=None):
         """Megatron-style grouped interleaving for M > S, S | M.
 
         Microbatches run in G = M/S groups of S.  Device d at tick t maps
@@ -871,10 +974,11 @@ class CompiledBertPipeline:
         tp = self.tp
         stage_mod = self.tp_stage if tp > 1 else self.stage
 
-        def body(local_stage_params, hidden_mb, mask_mb):
+        def body(local_stage_params, hidden_mb, mask_mb, *maybe_rng):
             local_stage_params = self._guard_tp_replicated(local_stage_params)
             d = lax.axis_index("pp")
             fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+            tick_rngs = self._stage_rng_stream(maybe_rng)
 
             def tick_coords(t):
                 """tau -> (active, chunk slot k_c, microbatch m_c, done)."""
@@ -910,7 +1014,7 @@ class CompiledBertPipeline:
                     inp_h = jnp.where(first, hidden_mb[m_c], recv_h)
                     inp_s = jnp.where(first, mask_mb[m_c], recv_s)
                     h, s = stage_mod.apply(
-                        {"params": params_k}, inp_h, inp_s
+                        {"params": params_k}, inp_h, inp_s, **tick_rngs(t)
                     )
                     w = jnp.where(done, m_c, M)
                     out_h = lax.dynamic_update_index_in_dim(out_h, h, w, 0)
@@ -938,7 +1042,7 @@ class CompiledBertPipeline:
                 inp = jnp.where(is_first_chunk & active, hidden_mb[m_c],
                                 recv)
                 out, _ = stage_mod.apply(
-                    {"params": params_k}, inp, mask_mb[m_c]
+                    {"params": params_k}, inp, mask_mb[m_c], **tick_rngs(t)
                 )
                 # only the final chunk's completions are real outputs
                 w = jnp.where(done, m_c, M)
@@ -952,14 +1056,33 @@ class CompiledBertPipeline:
             )
             return outputs[:M]
 
-        return self._run_ring_schedule(body, stage_params, hidden_mb, mask_mb)
+        return self._run_ring_schedule(body, stage_params, hidden_mb,
+                                       mask_mb, rng=rng)
+
+    def _check_rng(self, rng):
+        """Stochastic engines require a key; deterministic ones ignore it."""
+        if self.deterministic:
+            return None
+        if rng is None:
+            raise ValueError(
+                "this engine was built with deterministic=False (dropout "
+                "active); pass rng= to train_step/loss/_logits"
+            )
+        return rng
 
     # --- full model ----------------------------------------------------------
-    def _logits(self, params, input_ids, token_type_ids, attention_mask):
+    def _logits(self, params, input_ids, token_type_ids, attention_mask,
+                rng=None):
+        rng = self._check_rng(rng)
+        sub = (
+            (lambda i: None) if rng is None
+            else (lambda i: {"dropout": jax.random.fold_in(rng, i)})
+        )
         M = self.num_microbatches
         hidden, mask4 = self.embeddings.apply(
             {"params": params["embeddings"]},
             input_ids, token_type_ids, attention_mask,
+            rngs=sub(0),
         )
         B = hidden.shape[0]
         if B % M != 0:
@@ -971,27 +1094,28 @@ class CompiledBertPipeline:
         hidden_mb = hidden.reshape(M, B // M, *hidden.shape[1:])
         mask_mb = mask4.reshape(M, B // M, *mask4.shape[1:])
 
+        ring_rng = None if rng is None else jax.random.fold_in(rng, 1)
         if self.virtual_stages > 1:
             encoded = self._interleaved_encoder(
-                params["stages"], hidden_mb, mask_mb
+                params["stages"], hidden_mb, mask_mb, rng=ring_rng
             )
         else:
             encoded = self._pipelined_encoder(
-                params["stages"], hidden_mb, mask_mb
+                params["stages"], hidden_mb, mask_mb, rng=ring_rng
             )
         encoded = encoded.reshape(B, *encoded.shape[2:])
 
         pooled = self.pooler.apply(
-            {"params": params["pooler"]}, encoded, mask4
+            {"params": params["pooler"]}, encoded, mask4, rngs=sub(2)
         )
         return self.classifier.apply(
-            {"params": params["classifier"]}, pooled
+            {"params": params["classifier"]}, pooled, rngs=sub(3)
         )
 
-    def loss(self, params, batch, labels):
+    def loss(self, params, batch, labels, rng=None):
         input_ids, token_type_ids, attention_mask = batch
         logits = self._logits(
-            params, input_ids, token_type_ids, attention_mask
+            params, input_ids, token_type_ids, attention_mask, rng=rng
         )
         return optax.softmax_cross_entropy_with_integer_labels(
             logits.astype(jnp.float32), labels
@@ -1023,8 +1147,10 @@ class CompiledBertPipeline:
             jit_kwargs["out_shardings"] = (self.param_shardings, None, None)
 
         @functools.partial(jax.jit, donate_argnums=(0, 1), **jit_kwargs)
-        def train_step(params, opt_state, batch, labels):
-            loss, grads = jax.value_and_grad(self.loss)(params, batch, labels)
+        def train_step(params, opt_state, batch, labels, rng=None):
+            loss, grads = jax.value_and_grad(self.loss)(
+                params, batch, labels, rng
+            )
             if self.zero2:
                 # pin each gradient leaf to the same dp shards a
                 # ZeRO-sharded state tensor of that shape gets (params
@@ -1047,10 +1173,19 @@ class CompiledBertPipeline:
         self._train_step = train_step
         return train_step
 
-    def train_step(self, params, opt_state, batch, labels):
+    def train_step(self, params, opt_state, batch, labels, rng=None):
         if self._train_step is None:
             self.make_train_step()
-        return self._train_step(params, opt_state, batch, labels)
+        if self.deterministic:
+            if rng is not None:
+                raise ValueError(
+                    "rng= was passed but this engine is deterministic; "
+                    "build it with deterministic=False to train with "
+                    "dropout"
+                )
+            return self._train_step(params, opt_state, batch, labels)
+        self._check_rng(rng)
+        return self._train_step(params, opt_state, batch, labels, rng)
 
 
 __all__ = [

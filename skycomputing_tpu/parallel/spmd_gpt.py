@@ -18,8 +18,10 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 import flax.linen as nn
+from jax import lax
 
 from ..models.gpt import (
     ACT2FN,
@@ -39,17 +41,28 @@ from .spmd import CompiledBertPipeline, _TpDense, split_stage_params_for_tp
 GPT_TP_COL = ("q_proj", "k_proj", "v_proj", "c_fc")
 GPT_TP_ROW = ("c_proj",)
 
+# MoE expert tensors fit the same col/row role tables as direct
+# ``(module, param)`` pairs: w1/b1 [E, H, I]/[E, I] split the expert
+# intermediate (last axis, column role); w2 [E, I, H] splits its input
+# features (second-to-last, row role, psum after the expert down-proj);
+# router and b2 replicate (b2 is added after the psum)
+GPT_MOE_TP_COL = GPT_TP_COL + (("mlp", "w1"), ("mlp", "b1"))
+GPT_MOE_TP_ROW = GPT_TP_ROW + (("mlp", "w2"),)
+
 
 class GptEncoderUnit(nn.Module):
     """One transformer block (attention + MLP), tuple signature."""
 
     config: Any
+    deterministic: bool = True
 
     @nn.compact
     def __call__(self, hidden, dummy):
-        hidden = GptBlock_Attn(self.config, deterministic=True,
+        hidden = GptBlock_Attn(self.config,
+                               deterministic=self.deterministic,
                                name="attn")(hidden)
-        hidden = GptBlock_Mlp(self.config, deterministic=True,
+        hidden = GptBlock_Mlp(self.config,
+                              deterministic=self.deterministic,
                               name="mlp")(hidden)
         return hidden, dummy
 
@@ -59,12 +72,13 @@ class GptEncoderStage(nn.Module):
 
     config: Any
     units: int
+    deterministic: bool = True
 
     @nn.compact
     def __call__(self, hidden, dummy):
         for u in range(self.units):
             hidden, dummy = nn.remat(GptEncoderUnit)(
-                self.config, name=f"unit_{u}"
+                self.config, self.deterministic, name=f"unit_{u}"
             )(hidden, dummy)
         return hidden, dummy
 
@@ -86,6 +100,7 @@ class GptMoeEncoderStage(nn.Module):
     num_experts: int = 8
     top_k: int = 1
     capacity_factor: float = 1.25
+    deterministic: bool = True
 
     @nn.compact
     def __call__(self, hidden, side):
@@ -107,18 +122,19 @@ class GptMoeEncoderStage(nn.Module):
 
             @nn.compact
             def __call__(sf, h, s):
-                h = GptBlock_Attn(outer.config, deterministic=True,
+                det = outer.deterministic
+                h = GptBlock_Attn(outer.config, deterministic=det,
                                   name="attn")(h)
                 if sf.is_moe:
                     h, aux = GptBlock_MoeMlp(
                         outer.config, num_experts=outer.num_experts,
                         top_k=outer.top_k,
                         capacity_factor=outer.capacity_factor,
-                        deterministic=True, return_aux=True, name="mlp",
+                        deterministic=det, return_aux=True, name="mlp",
                     )(h)
                     s = s + aux.astype(s.dtype)
                 else:
-                    h = GptBlock_Mlp(outer.config, deterministic=True,
+                    h = GptBlock_Mlp(outer.config, deterministic=det,
                                      name="mlp")(h)
                 return h, s
 
@@ -128,6 +144,170 @@ class GptMoeEncoderStage(nn.Module):
                 hidden, side
             )
         return hidden, side
+
+
+def _check_tp_divisibility(cfg, tp: int) -> None:
+    if (
+        cfg.hidden_size % tp
+        or cfg.num_attention_heads % tp
+        or cfg.intermediate_size % tp
+    ):
+        raise ValueError(
+            f"hidden/heads/intermediate "
+            f"({cfg.hidden_size}/{cfg.num_attention_heads}/"
+            f"{cfg.intermediate_size}) must all be divisible by tp={tp}"
+        )
+
+
+class _TpGptAttn(nn.Module):
+    """Megatron attention half: col-parallel q/k/v, row-parallel c_proj.
+
+    GPT's block dropouts all act on REPLICATED activations (after the
+    row-parallel psum), so under ``deterministic=False`` they draw from
+    the shared per-tick key — identical masks on every tp rank keep the
+    replicas equal; no per-rank desync is needed anywhere in this family.
+    """
+
+    config: Any
+    tp: int
+    axis_name: str = "tp"
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, hidden):
+        cfg = GptConfig.from_dict(self.config)
+        dtype = jnp.dtype(cfg.dtype)
+        n_heads = cfg.num_attention_heads // self.tp
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        h_local = cfg.hidden_size // self.tp
+        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32,
+                         name="ln_1")(hidden).astype(dtype)
+        mk = lambda nm: _TpDense(h_local, dtype, "col", self.axis_name,
+                                 name=nm)
+        split = lambda t: t.reshape(
+            t.shape[0], t.shape[1], n_heads, head_dim
+        )
+        q = split(mk("q_proj")(x))
+        k = split(mk("k_proj")(x))
+        v = split(mk("v_proj")(x))
+        scores = jnp.einsum("blhd,bmhd->bhlm", q, k) / jnp.sqrt(
+            jnp.asarray(head_dim, dtype)
+        )
+        L = q.shape[1]
+        causal = jnp.tril(jnp.ones((L, L), bool))
+        scores = jnp.where(causal[None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(
+            scores.astype(jnp.float32), axis=-1
+        ).astype(dtype)
+        ctx = jnp.einsum("bhlm,bmhd->blhd", probs, v)
+        ctx = ctx.reshape(ctx.shape[0], ctx.shape[1], h_local)
+        out = _TpDense(cfg.hidden_size, dtype, "row", self.axis_name,
+                       name="c_proj")(ctx)
+        out = nn.Dropout(cfg.dropout_prob)(
+            out, deterministic=self.deterministic
+        )
+        return hidden + out
+
+
+class _TpGptMlp(nn.Module):
+    """Megatron dense MLP half: col-parallel c_fc, row-parallel c_proj."""
+
+    config: Any
+    tp: int
+    axis_name: str = "tp"
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, hidden):
+        cfg = GptConfig.from_dict(self.config)
+        dtype = jnp.dtype(cfg.dtype)
+        i_local = cfg.intermediate_size // self.tp
+        act = ACT2FN[cfg.hidden_act]
+        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32,
+                         name="ln_2")(hidden).astype(dtype)
+        x = act(_TpDense(i_local, dtype, "col", self.axis_name,
+                         name="c_fc")(x))
+        x = _TpDense(cfg.hidden_size, dtype, "row", self.axis_name,
+                     name="c_proj")(x)
+        x = nn.Dropout(cfg.dropout_prob)(
+            x, deterministic=self.deterministic
+        )
+        return hidden + x
+
+
+class _TpGptMoeMlp(nn.Module):
+    """Megatron-sharded Switch MoE MLP half for the pipeline body.
+
+    Expert intermediates split across tp: w1/b1 hold the ``I/tp`` column
+    shard, w2 the matching row shard whose partial expert outputs are
+    ``psum``-reduced before the replicated b2 — the same col/row algebra as
+    the dense blocks, lifted onto the leading expert axis (see
+    ``GPT_MOE_TP_COL``/``GPT_MOE_TP_ROW``).  Router, dispatch, and the aux
+    loss are computed identically on every tp rank from the replicated
+    activations, so no collective is needed for routing.  Param tree
+    mirrors the monolithic :class:`~..models.gpt.GptBlock_MoeMlp`
+    (``router``/``w1``..``b2`` under ``mlp``) with tp-local leaf shapes.
+    """
+
+    config: Any
+    tp: int
+    num_experts: int = 8
+    top_k: int = 1
+    capacity_factor: float = 1.25
+    axis_name: str = "tp"
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, hidden):
+        from ..ops.moe import (
+            moe_dispatch_combine,
+            router_probs,
+            top_k_dispatch,
+        )
+
+        cfg = GptConfig.from_dict(self.config)
+        dtype = jnp.dtype(cfg.dtype)
+        act = ACT2FN[cfg.hidden_act]
+        E, H = self.num_experts, cfg.hidden_size
+        i_local = cfg.intermediate_size // self.tp
+
+        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln_2")(
+            hidden
+        ).astype(dtype)
+        B, L, _ = x.shape
+        tokens = x.reshape(B * L, H)
+        T = B * L
+        capacity = max(1, int(np.ceil(T / E * self.capacity_factor)))
+
+        router = self.param(
+            "router", nn.initializers.normal(cfg.initializer_range), (H, E),
+            jnp.float32,
+        )
+        init = nn.initializers.normal(cfg.initializer_range)
+        w1 = self.param("w1", init, (E, H, i_local), jnp.float32)
+        b1 = self.param("b1", nn.initializers.zeros, (E, i_local),
+                        jnp.float32)
+        w2 = self.param("w2", init, (E, i_local, H), jnp.float32)
+        b2 = self.param("b2", nn.initializers.zeros, (E, H), jnp.float32)
+
+        probs = router_probs(tokens, router)
+        dispatch, combine, aux = top_k_dispatch(probs, capacity, self.top_k)
+
+        def experts(buf):  # [E, C, H] -> [E, C, H]
+            h = act(
+                jnp.einsum("ech,ehi->eci", buf, w1.astype(dtype))
+                + b1[:, None, :].astype(dtype)
+            )
+            partial = jnp.einsum("eci,eih->ech", h, w2.astype(dtype))
+            full = lax.psum(partial, self.axis_name)
+            return full + b2[:, None, :].astype(dtype)
+
+        out = moe_dispatch_combine(tokens, dispatch, combine, experts)
+        out = out.reshape(B, L, H).astype(dtype)
+        out = nn.Dropout(cfg.dropout_prob)(
+            out, deterministic=self.deterministic
+        )
+        return hidden + out, aux
 
 
 class TpGptUnit(nn.Module):
@@ -145,70 +325,16 @@ class TpGptUnit(nn.Module):
     config: Any
     tp: int
     axis_name: str = "tp"
+    deterministic: bool = True
 
     @nn.compact
     def __call__(self, hidden, dummy):
         cfg = GptConfig.from_dict(self.config)
-        dtype = jnp.dtype(cfg.dtype)
-        if (
-            cfg.hidden_size % self.tp
-            or cfg.num_attention_heads % self.tp
-            or cfg.intermediate_size % self.tp
-        ):
-            raise ValueError(
-                f"hidden/heads/intermediate "
-                f"({cfg.hidden_size}/{cfg.num_attention_heads}/"
-                f"{cfg.intermediate_size}) must all be divisible by "
-                f"tp={self.tp}"
-            )
-        n_heads = cfg.num_attention_heads // self.tp
-        head_dim = cfg.hidden_size // cfg.num_attention_heads
-        h_local = cfg.hidden_size // self.tp
-        i_local = cfg.intermediate_size // self.tp
-        tp_axis = self.axis_name
-
-        class Attn(nn.Module):
-            @nn.compact
-            def __call__(sf, hidden):
-                x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32,
-                                 name="ln_1")(hidden).astype(dtype)
-                mk = lambda nm: _TpDense(h_local, dtype, "col", tp_axis,
-                                         name=nm)
-                split = lambda t: t.reshape(
-                    t.shape[0], t.shape[1], n_heads, head_dim
-                )
-                q = split(mk("q_proj")(x))
-                k = split(mk("k_proj")(x))
-                v = split(mk("v_proj")(x))
-                scores = jnp.einsum("blhd,bmhd->bhlm", q, k) / jnp.sqrt(
-                    jnp.asarray(head_dim, dtype)
-                )
-                L = q.shape[1]
-                causal = jnp.tril(jnp.ones((L, L), bool))
-                scores = jnp.where(causal[None, None], scores, -jnp.inf)
-                probs = jax.nn.softmax(
-                    scores.astype(jnp.float32), axis=-1
-                ).astype(dtype)
-                ctx = jnp.einsum("bhlm,bmhd->blhd", probs, v)
-                ctx = ctx.reshape(ctx.shape[0], ctx.shape[1], h_local)
-                out = _TpDense(cfg.hidden_size, dtype, "row", tp_axis,
-                               name="c_proj")(ctx)
-                return hidden + out
-
-        class Mlp(nn.Module):
-            @nn.compact
-            def __call__(sf, hidden):
-                act = ACT2FN[cfg.hidden_act]
-                x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32,
-                                 name="ln_2")(hidden).astype(dtype)
-                x = act(_TpDense(i_local, dtype, "col", tp_axis,
-                                 name="c_fc")(x))
-                x = _TpDense(cfg.hidden_size, dtype, "row", tp_axis,
-                             name="c_proj")(x)
-                return hidden + x
-
-        hidden = Attn(name="attn")(hidden)
-        hidden = Mlp(name="mlp")(hidden)
+        _check_tp_divisibility(cfg, self.tp)
+        hidden = _TpGptAttn(self.config, self.tp, self.axis_name,
+                            self.deterministic, name="attn")(hidden)
+        hidden = _TpGptMlp(self.config, self.tp, self.axis_name,
+                           self.deterministic, name="mlp")(hidden)
         return hidden, dummy
 
 
@@ -219,14 +345,74 @@ class TpGptStage(nn.Module):
     units: int
     tp: int
     axis_name: str = "tp"
+    deterministic: bool = True
 
     @nn.compact
     def __call__(self, hidden, dummy):
         for u in range(self.units):
             hidden, dummy = nn.remat(TpGptUnit)(
-                self.config, self.tp, self.axis_name, name=f"unit_{u}"
+                self.config, self.tp, self.axis_name, self.deterministic,
+                name=f"unit_{u}",
             )(hidden, dummy)
         return hidden, dummy
+
+
+class TpGptMoeStage(nn.Module):
+    """``units`` tensor-parallel blocks, every ``moe_every``-th MLP a
+    tp-sharded Switch MoE; same stage-local placement rule and side-tensor
+    aux accumulation as :class:`GptMoeEncoderStage`, same remat policy as
+    :class:`TpGptStage`."""
+
+    config: Any
+    units: int
+    moe_every: int
+    tp: int
+    num_experts: int = 8
+    top_k: int = 1
+    capacity_factor: float = 1.25
+    axis_name: str = "tp"
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, hidden, side):
+        if self.moe_every <= 0 or self.units % self.moe_every:
+            raise ValueError(
+                f"moe_every ({self.moe_every}) must divide units_per_stage "
+                f"({self.units}) so the per-stage MoE pattern matches the "
+                f"monolithic block placement"
+            )
+        cfg = GptConfig.from_dict(self.config)
+        _check_tp_divisibility(cfg, self.tp)
+        outer = self
+
+        class Unit(nn.Module):
+            is_moe: bool
+
+            @nn.compact
+            def __call__(sf, h, s):
+                det = outer.deterministic
+                h = _TpGptAttn(outer.config, outer.tp, outer.axis_name,
+                               det, name="attn")(h)
+                if sf.is_moe:
+                    h, aux = _TpGptMoeMlp(
+                        outer.config, outer.tp,
+                        num_experts=outer.num_experts, top_k=outer.top_k,
+                        capacity_factor=outer.capacity_factor,
+                        axis_name=outer.axis_name, deterministic=det,
+                        name="mlp",
+                    )(h)
+                    s = s + aux.astype(s.dtype)
+                else:
+                    h = _TpGptMlp(outer.config, outer.tp, outer.axis_name,
+                                  det, name="mlp")(h)
+                return h, s
+
+        for u in range(self.units):
+            is_moe = (u + 1) % self.moe_every == 0
+            hidden, side = nn.remat(Unit)(is_moe, name=f"unit_{u}")(
+                hidden, side
+            )
+        return hidden, side
 
 
 class CompiledGptPipeline(CompiledBertPipeline):
@@ -256,37 +442,58 @@ class CompiledGptPipeline(CompiledBertPipeline):
 
     def _build_modules(self, units_per_stage: int, num_classes: int) -> None:
         cfg_dict = self.cfg.to_dict()
-        self.embeddings = GptEmbeddings(cfg_dict, deterministic=True)
+        det = self.deterministic
+        self.embeddings = GptEmbeddings(cfg_dict, deterministic=det)
         if self.moe_every:
-            if self.tp > 1:
-                raise NotImplementedError(
-                    "MoE stages do not compose with in-pipeline tensor "
-                    "parallelism yet"
-                )
             self.stage = GptMoeEncoderStage(
                 cfg_dict, units_per_stage, self.moe_every,
                 self.num_experts, self.moe_top_k, self.moe_capacity_factor,
+                deterministic=det,
             )
             self.side_outputs = True
+            # expert tensors join the Megatron role tables (w1/b1 column,
+            # w2 row, router/b2 replicated) for both weight splitting and
+            # the replicated-gradient guard
+            self.tp_col_modules = GPT_MOE_TP_COL
+            self.tp_row_modules = GPT_MOE_TP_ROW
+            self.tp_stage = (
+                TpGptMoeStage(
+                    cfg_dict, units_per_stage, self.moe_every, self.tp,
+                    self.num_experts, self.moe_top_k,
+                    self.moe_capacity_factor, deterministic=det,
+                )
+                if self.tp > 1 else None
+            )
         else:
-            self.stage = GptEncoderStage(cfg_dict, units_per_stage)
-        self.tp_stage = (
-            TpGptStage(cfg_dict, units_per_stage, self.tp)
-            if self.tp > 1 else None
-        )
-        self.lm_head = GptLmHead(cfg_dict, deterministic=True)
+            self.stage = GptEncoderStage(cfg_dict, units_per_stage,
+                                         deterministic=det)
+            self.tp_stage = (
+                TpGptStage(cfg_dict, units_per_stage, self.tp,
+                           deterministic=det)
+                if self.tp > 1 else None
+            )
+        self.lm_head = GptLmHead(cfg_dict, deterministic=det)
 
     # --- init ----------------------------------------------------------------
     def init(self, rng: jax.Array, input_ids):
         from jax.sharding import NamedSharding
 
         k_embed, k_stage, k_head = jax.random.split(rng, 3)
-        embed_vars = self.embeddings.init({"params": k_embed}, input_ids)
-        hidden = self.embeddings.apply(embed_vars, input_ids)
+        drop = (
+            {} if self.deterministic
+            else {"dropout": jax.random.fold_in(rng, 99)}
+        )
+        embed_vars = self.embeddings.init(
+            {"params": k_embed, **drop}, input_ids
+        )
+        hidden = self.embeddings.apply(embed_vars, input_ids,
+                                       rngs=drop or None)
         dummy = jnp.zeros((), hidden.dtype)
 
         def init_one_stage(key):
-            return self.stage.init({"params": key}, hidden, dummy)["params"]
+            return self.stage.init(
+                {"params": key, **drop}, hidden, dummy
+            )["params"]
 
         S, V = self.num_stages, self.virtual_stages
         chunk_keys = jax.random.split(k_stage, S * V)
@@ -297,7 +504,7 @@ class CompiledGptPipeline(CompiledBertPipeline):
                 stages, self.tp, self.tp_col_modules, self.tp_row_modules
             )
 
-        head_vars = self.lm_head.init({"params": k_head}, hidden)
+        head_vars = self.lm_head.init({"params": k_head, **drop}, hidden)
         params = {
             "embeddings": embed_vars["params"],
             "stages": stages,
@@ -311,10 +518,15 @@ class CompiledGptPipeline(CompiledBertPipeline):
         return jax.device_put(params, self.param_shardings)
 
     # --- full model ----------------------------------------------------------
-    def _logits(self, params, input_ids):
+    def _logits(self, params, input_ids, rng=None):
+        rng = self._check_rng(rng)
+        sub = (
+            (lambda i: None) if rng is None
+            else (lambda i: {"dropout": jax.random.fold_in(rng, i)})
+        )
         M = self.num_microbatches
         hidden = self.embeddings.apply(
-            {"params": params["embeddings"]}, input_ids
+            {"params": params["embeddings"]}, input_ids, rngs=sub(0)
         )
         B = hidden.shape[0]
         if B % M != 0:
@@ -337,18 +549,21 @@ class CompiledGptPipeline(CompiledBertPipeline):
         aux = None
         encoder = (self._interleaved_encoder if self.virtual_stages > 1
                    else self._pipelined_encoder)
-        encoded = encoder(params["stages"], hidden_mb, dummy_mb)
+        ring_rng = None if rng is None else jax.random.fold_in(rng, 1)
+        encoded = encoder(params["stages"], hidden_mb, dummy_mb,
+                          rng=ring_rng)
         if self.side_outputs:
             # the side rides the ring as a per-microbatch aux accumulator
             encoded, side_out = encoded
             aux = side_out.mean()  # avg over microbatches of summed aux
         encoded = encoded.reshape(B, *encoded.shape[2:])
-        logits = self.lm_head.apply({"params": params["lm_head"]}, encoded)
+        logits = self.lm_head.apply({"params": params["lm_head"]}, encoded,
+                                    rngs=sub(2))
         return (logits, aux) if self.side_outputs else logits
 
-    def loss(self, params, batch, labels):
+    def loss(self, params, batch, labels, rng=None):
         (input_ids,) = batch if isinstance(batch, tuple) else (batch,)
-        out = self._logits(params, input_ids)
+        out = self._logits(params, input_ids, rng=rng)
         if self.side_outputs:
             logits, aux = out
             return causal_lm_loss(logits, labels) + (
@@ -362,8 +577,11 @@ __all__ = [
     "GptEncoderStage",
     "GptEncoderUnit",
     "GptMoeEncoderStage",
+    "TpGptMoeStage",
     "TpGptStage",
     "TpGptUnit",
     "GPT_TP_COL",
     "GPT_TP_ROW",
+    "GPT_MOE_TP_COL",
+    "GPT_MOE_TP_ROW",
 ]

@@ -243,3 +243,25 @@ def test_finetune_from_converted_weights_beats_random_init(tmp_path):
         tuned.train_step(data, labels, rng=jax.random.key(100 + i))
     end = eval_loss(tuned)
     assert end < 0.5 * random_loss, (end, random_loss)
+
+
+def test_reference_scale_pth_roundtrip_two_allocations(tmp_path):
+    """VERDICT r03 task #6: BERT-large (L-24/H-1024/A-16) reference-layout
+    .pth through the converter, loaded under TWO allocations, fine-tuned.
+    Delegates to tools/pretrained_large_finetune.py (the artifact
+    generator) so the test and the committed PRETRAINED_r04.json exercise
+    one code path; its assertions are: losses finite and falling under
+    both allocations, and step-for-step equal across them (float
+    tolerance) — the converted checkpoint is partition-independent."""
+    import os.path as osp
+    import sys
+
+    sys.path.insert(0, osp.join(
+        osp.dirname(osp.dirname(osp.abspath(__file__))), "tools"
+    ))
+    from pretrained_large_finetune import run
+
+    result = run(units=24, steps=2, batch=2, seq=16, workers=4,
+                 out_json=None, tmp_dir=str(tmp_path))
+    assert result["params_millions"] > 300  # genuinely BERT-large scale
+    assert result["max_step_loss_diff_across_allocations"] < 1e-4

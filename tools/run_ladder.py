@@ -56,11 +56,16 @@ def run_one(name: str, max_iters: int, log_root: str,
     result = {"config": name, "exit": proc.returncode}
 
     # find this run's allocation.log (layout encodes the matrix cell)
+    # loss matches \S+ (a diverged rung prints 'loss: nan' — its timings
+    # must still be recorded); non-finite losses are kept in the record so
+    # the divergence is visible
     phase = re.compile(
-        r"forward time: ([\d.]+) \| backward time: ([\d.]+) \| "
-        r"step time: ([\d.]+)"
+        r"loss: (\S+) \| forward time: ([\d.]+) \| "
+        r"backward time: ([\d.]+) \| step time: ([\d.]+)"
     )
-    fwd, bwd, step = [], [], []
+    alloc = re.compile(r"worker rank (\d+): layers \((\d+), (\d+)\)")
+    loss, fwd, bwd, step = [], [], [], []
+    layers_by_rank = {}
     for root, _, files in os.walk(log_root):
         for f in files:
             if f != "allocation.log":
@@ -68,9 +73,23 @@ def run_one(name: str, max_iters: int, log_root: str,
             for line in open(os.path.join(root, f)):
                 m = phase.search(line)
                 if m:
-                    fwd.append(float(m.group(1)))
-                    bwd.append(float(m.group(2)))
-                    step.append(float(m.group(3)))
+                    try:
+                        loss.append(float(m.group(1)))
+                    except ValueError:
+                        loss.append(None)
+                    fwd.append(float(m.group(2)))
+                    bwd.append(float(m.group(3)))
+                    step.append(float(m.group(4)))
+                m = alloc.search(line)
+                if m:
+                    layers_by_rank[int(m.group(1))] = (
+                        int(m.group(3)) - int(m.group(2))
+                    )
+    result["losses"] = loss
+    if layers_by_rank:
+        result["allocation"] = [
+            layers_by_rank[r] for r in sorted(layers_by_rank)
+        ]
     if len(fwd) > 1:  # drop the compile-heavy first iteration
         fwd, bwd, step = fwd[1:], bwd[1:], step[1:]
     if fwd:
@@ -89,6 +108,10 @@ def main() -> int:
                         help="subset of config names (without .py)")
     parser.add_argument("--max-iters", type=int, default=5)
     parser.add_argument("--log-root", default="/tmp/skytpu_ladder")
+    parser.add_argument("--timeout", type=float, default=3600,
+                        help="per-rung wall budget (s)")
+    parser.add_argument("--json", default=None,
+                        help="write the per-rung records to this JSON file")
     args = parser.parse_args()
 
     names = args.only or CONFIGS
@@ -101,7 +124,23 @@ def main() -> int:
     for i, name in enumerate(names):
         log_root = os.path.join(args.log_root, name)
         print(f"[{i + 1}/{len(names)}] {name} ...", flush=True)
-        rows.append(run_one(name, args.max_iters, log_root))
+        rows.append(run_one(name, args.max_iters, log_root,
+                            timeout=args.timeout))
+
+    if args.json:
+        import json
+
+        with open(args.json, "w") as fh:
+            json.dump(
+                dict(
+                    max_iters=args.max_iters,
+                    preset=os.getenv("SKYTPU_PRESET", "(config default)"),
+                    platform=os.getenv("JAX_PLATFORMS", "(default)"),
+                    rungs=rows,
+                ),
+                fh, indent=2,
+            )
+        print(f"wrote {args.json}")
 
     print(f"\n{'config':24s} {'exit':>7s} {'fwd_s':>9s} {'bwd_s':>9s} "
           f"{'step_s':>9s}")
