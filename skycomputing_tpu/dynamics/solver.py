@@ -409,7 +409,7 @@ def solve_contiguous_minmax(
     native_exact_limit: int = 18,
     anneal_seconds: float = 300.0,
     anneal_evals: int = 3000,
-    anneal_rounds: int = 5,
+    anneal_rounds: int = 6,
     gap_target: float = 0.01,
 ) -> PartitionResult:
     """Minimize max_d device_time[d] * sum(layer_cost[slice_d]).
