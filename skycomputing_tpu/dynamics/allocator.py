@@ -124,6 +124,47 @@ class Allocator:
         return self._apply_partition(worker_ranks, ranges, orders)
 
     # ----------------------------------------------------- closed-loop refine
+    def calibrate_costs(
+        self, stage_layer_counts, measured_stage_times,
+        damping: float = 1.0,
+    ) -> None:
+        """Rescale the per-layer cost model from ANY allocation's measured
+        stage times — without re-solving.
+
+        ``stage_layer_counts``/``measured_stage_times``: pipeline-order
+        slice lengths and raw per-stage seconds of the allocation that was
+        measured (need not be this allocator's current one).  The classic
+        use is seeding the *first* optimal solve from the even baseline's
+        measurement, which the headline bench takes anyway: isolated
+        per-unit profiles miss slice-level fusion/cache effects, while the
+        even pass measures every layer at deployment granularity for free.
+        ``refine_allocation`` is this plus a re-solve, with the counts
+        read from the allocator's own current allocation.
+        """
+        base_costs, _ = self._model_benchmarker.benchmark()
+        costs = list(
+            self._cost_override
+            if getattr(self, "_cost_override", None) is not None
+            else base_costs
+        )
+        if len(stage_layer_counts) != len(measured_stage_times):
+            raise ValueError(
+                f"{len(measured_stage_times)} measured times for "
+                f"{len(stage_layer_counts)} stages"
+            )
+        pos = 0
+        for n, t in zip(stage_layer_counts, measured_stage_times):
+            pred = sum(costs[pos:pos + n])
+            if pred > 0 and t > 0:
+                scale = (float(t) / pred) ** float(damping)
+                costs[pos:pos + n] = [c * scale for c in costs[pos:pos + n]]
+            pos += n
+        if pos != len(costs):
+            raise ValueError(
+                f"stage slices cover {pos} layers, model has {len(costs)}"
+            )
+        self._cost_override = costs
+
     def refine_allocation(
         self, measured_stage_times, damping: float = 0.5
     ) -> WorkerManager:
@@ -150,13 +191,6 @@ class Allocator:
         slice's layers, so re-solved boundaries re-mix them — while a
         damped update contracts toward a fixed point.
         """
-        base_costs, _ = self._model_benchmarker.benchmark()
-        costs = list(
-            self._cost_override
-            if getattr(self, "_cost_override", None) is not None
-            else base_costs
-        )
-
         workers = sorted(
             (w for w in self._worker_manager.worker_pool if w.model_config),
             key=lambda w: w.order,
@@ -166,19 +200,11 @@ class Allocator:
                 f"{len(measured_stage_times)} measured times for "
                 f"{len(workers)} non-empty stages"
             )
-        pos = 0
-        for worker, t in zip(workers, measured_stage_times):
-            n = len(worker.model_config)
-            pred = sum(costs[pos:pos + n])
-            if pred > 0 and t > 0:
-                scale = (float(t) / pred) ** float(damping)
-                costs[pos:pos + n] = [c * scale for c in costs[pos:pos + n]]
-            pos += n
-        if pos != len(costs):
-            raise ValueError(
-                f"stage slices cover {pos} layers, model has {len(costs)}"
-            )
-        self._cost_override = costs
+        self.calibrate_costs(
+            [len(w.model_config) for w in workers],
+            measured_stage_times,
+            damping=damping,
+        )
         return self.optimal_allocate()
 
     # --------------------------------------------------------------- dynamic

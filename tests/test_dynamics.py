@@ -369,3 +369,65 @@ def test_refine_allocation_rejects_mismatched_measurements():
     alloc.optimal_allocate()
     with pytest.raises(ValueError):
         alloc.refine_allocation([0.1])  # two non-empty stages, one time
+
+
+def test_calibrate_costs_from_even_baseline_improves_real_allocation():
+    """Seeding the cost model from the even baseline's measured stage
+    times (the headline bench's free calibration pass) lets the solver
+    see per-layer cost structure the flat profile hides entirely.  The
+    true costs differ BETWEEN even slices (cheap first half, 3x second
+    half) so the calibration is informative — an alternating pattern
+    whose slice sums coincide would make this test vacuous."""
+    # flat profile, but reality: second half of the model is 3x heavier
+    true_costs = [1.0] * 8 + [3.0] * 8
+    times = [1.0, 1.0, 2.0, 2.0]
+    times_by_name = {f"node-{i}": t for i, t in enumerate(times)}
+    alloc, wm = _make_allocator(
+        times, [1000.0] * 4, [1.0] * 16, [0.1] * 16, n_layers=16
+    )
+
+    def true_bottleneck():
+        worst = 0.0
+        pos = 0
+        for w in sorted(wm.worker_pool, key=lambda w: w.order):
+            n = len(w.model_config or [])
+            if n:
+                worst = max(
+                    worst,
+                    times_by_name[w.name] * sum(true_costs[pos:pos + n]),
+                )
+                pos += n
+        return worst
+
+    alloc.optimal_allocate()
+    uncalibrated = true_bottleneck()
+
+    # the even baseline: 4 layers each, measured = true slice sums
+    even_counts = [4, 4, 4, 4]
+    even_measured = [
+        sum(true_costs[i * 4:(i + 1) * 4]) for i in range(4)
+    ]
+    alloc2, wm2 = _make_allocator(
+        times, [1000.0] * 4, [1.0] * 16, [0.1] * 16, n_layers=16
+    )
+    wm = wm2  # true_bottleneck closure reads the new pool
+
+    alloc2.calibrate_costs(even_counts, even_measured)
+    # the calibrated per-layer costs must sum to the measured slice times
+    pos = 0
+    for n, t in zip(even_counts, even_measured):
+        assert abs(sum(alloc2._cost_override[pos:pos + n]) - t) < 1e-9
+        pos += n
+    alloc2.optimal_allocate()
+    calibrated = true_bottleneck()
+    # STRICT improvement: the flat-profile solve loads a slow device with
+    # heavy-half layers it cannot see (true bottleneck 14); the
+    # calibrated solve knows the second half is 3x and rebalances
+    # (true bottleneck 12).  A no-op calibration would fail this.
+    assert calibrated < uncalibrated - 1e-9, (uncalibrated, calibrated)
+
+    # mismatched counts are rejected
+    import pytest
+
+    with pytest.raises(ValueError):
+        alloc2.calibrate_costs([4, 4], [1.0, 2.0, 3.0])
