@@ -33,13 +33,34 @@ Method (single chip or many):
 The metric is the step-time improvement of optimal over even; vs_baseline
 divides by the reference's published 55%.
 
+Driver contract — the JSON line cannot fail to appear
+-----------------------------------------------------
+Round 4's lesson (VERDICT r04 missing #1): the driver runs this script
+under a wall-clock ``timeout`` and records the last JSON line of stdout;
+r04's default path outran the budget, was killed, and recorded *nothing*
+(rc 124, parsed null) despite a 74.75% capability.  This version is
+deadline-aware end to end:
+
+- ``SKYTPU_BENCH_DEADLINE_S`` (default 1680 s ≈ 28 min) is the wall
+  budget, counted from FIRST process start (the CPU-fallback re-exec
+  inherits the original T0 via ``SKYTPU_BENCH_T0``);
+- the probe ladder consults ``logs/tpu_watch.jsonl``: a fresh dead-probe
+  entry from the standing watcher shrinks 3x180 s of probing to one 60 s
+  confirm probe;
+- refine iterations, the final re-measurement, and the ffn/1 side number
+  each run only if the remaining budget affords them (estimated from the
+  measured duration of the previous pass);
+- SIGTERM/SIGALRM print the best-so-far JSON line (with a ``partial``
+  provenance field) before exiting — a timeout kill can no longer yield
+  zero bytes of result.
+
 Prints exactly one JSON line with machine-readable provenance:
     {"metric": ..., "value": ..., "unit": "percent", "vs_baseline": ...,
      "platform": "tpu"|"cpu", "device_kind": ..., "probe_attempts": N,
-     "fallback_reason": null | "..."}
+     "fallback_reason": null | "...", "partial": absent | "..."}
 
 On a live accelerator it also runs ``tools/bench_mfu.py`` and writes the
-single-chip MFU artifact to ``MFU_r04.json`` (disable with
+single-chip MFU artifact to ``MFU_r05.json`` (disable with
 SKYTPU_BENCH_EMIT_MFU=0).
 
 Env knobs: SKYTPU_BENCH_WORKERS (64), SKYTPU_BENCH_LAYER_NUM (53 trios ->
@@ -49,6 +70,10 @@ SKYTPU_BENCH_SLOWDOWN (paper | stimulator), SKYTPU_BENCH_REPEATS (4),
 SKYTPU_BENCH_MEM_REGIME (reference | tight), SKYTPU_BENCH_MEM_MB
 (numeric override of the raw per-worker budget),
 SKYTPU_BENCH_PROBE_ATTEMPTS (3) / SKYTPU_BENCH_PROBE_TIMEOUT (180s each),
+SKYTPU_BENCH_DEADLINE_S (1680), SKYTPU_BENCH_SOLVER_S (adaptive <=90),
+SKYTPU_BENCH_REFINE (0 — the affine first solve is the
+fixed point; deadline-gated when enabled), SKYTPU_BENCH_EVEN_BRACKET (1),
+SKYTPU_BENCH_CALIBRATION (affine | scale | 0),
 SKYTPU_BENCH_SEQUENTIAL=1 to score the reference's non-microbatched
 schedule (sum of stage times) instead.
 """
@@ -57,11 +82,101 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# Wall budget counted from the FIRST process start: the CPU-fallback
+# re-exec below replaces the process, so T0 rides an env var.
+# 1680 s = 28 min: the driver's observed kill budget is ~30 min (r04 was
+# killed mid-measure ~28-30 min in); the alarm backstop fires at
+# deadline+60 s, still inside the driver's window, and every pass is
+# gated so the normal path finishes well before.
+_T0 = float(os.environ.setdefault("SKYTPU_BENCH_T0", repr(time.time())))
+_DEADLINE_S = float(os.getenv("SKYTPU_BENCH_DEADLINE_S", "1680"))
+
+
+def _elapsed() -> float:
+    return time.time() - _T0
+
+
+def _time_left() -> float:
+    return _DEADLINE_S - _elapsed()
+
+
+# Best-so-far result, updated in place as passes complete; the signal
+# handlers and the normal exit path both print it exactly once.
+_RESULT = {
+    "metric": None,
+    "value": None,
+    "unit": "percent",
+    "vs_baseline": None,
+    "partial": "startup: no measurement completed yet",
+}
+_EMITTED = False
+
+
+def _emit() -> None:
+    global _EMITTED
+    if _EMITTED:
+        return
+    _EMITTED = True
+    out = {k: v for k, v in _RESULT.items() if k != "partial" or v}
+    out["elapsed_s"] = round(_elapsed(), 1)
+    out["deadline_s"] = _DEADLINE_S
+    print(json.dumps(out), flush=True)
+
+
+def _on_signal(signum, frame):
+    _RESULT.setdefault("partial", None)
+    if not _RESULT.get("partial"):
+        _RESULT["partial"] = f"killed by signal {signum}"
+    else:
+        _RESULT["partial"] = (
+            f"{_RESULT['partial']}; killed by signal {signum}"
+        )
+    _emit()
+    os._exit(0)
+
+
+signal.signal(signal.SIGTERM, _on_signal)
+signal.signal(signal.SIGALRM, _on_signal)
+# hard backstop: if the deadline-aware logic miscalculates (e.g. one XLA
+# compile blows past its estimate), SIGALRM still emits best-so-far with
+# a little grace for the driver's own timeout margin
+signal.alarm(max(int(_time_left()) + 60, 60))
+
+
+def _last_dead_probe_age_s():
+    """Seconds since the standing watcher (tools/tpu_watch.py) last logged
+    a dead probe — None if the log is absent or its last probe succeeded."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "logs", "tpu_watch.jsonl"
+    )
+    last = None
+    try:
+        with open(path) as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if "probe" in rec:
+                    last = rec
+    except OSError:
+        return None
+    if not last or last.get("probe") not in ("hung", "error"):
+        return None
+    try:
+        from datetime import datetime
+
+        ts = datetime.fromisoformat(last["ts"])
+        return max((datetime.now() - ts).total_seconds(), 0.0)
+    except (KeyError, ValueError):
+        return None
 
 
 def _probe_backend_or_fallback() -> None:
@@ -71,10 +186,14 @@ def _probe_backend_or_fallback() -> None:
     cold remote backend can also legitimately take minutes to serve its
     first compile, so a single short probe cannot distinguish the two
     (VERDICT r02 weak #4).  The probe therefore retries with a generous
-    per-attempt budget (default 3 x 180 s) before giving up, and the
-    outcome — platform, attempts used, fallback reason — is threaded into
-    the output JSON via env so the record is machine-readable either way.
-    Probes run in subprocesses so a hung runtime cannot wedge this process.
+    per-attempt budget (default 3 x 180 s) before giving up — UNLESS the
+    standing watcher already proved the tunnel dead within the last
+    ``SKYTPU_BENCH_WATCH_FRESH_S`` (2 h): then one 60 s confirm probe
+    suffices, returning ~9 min of the wall budget to the measurement
+    passes (VERDICT r04 task #1c).  The outcome — platform, attempts
+    used, fallback reason — is threaded into the output JSON via env so
+    the record is machine-readable either way.  Probes run in
+    subprocesses so a hung runtime cannot wedge this process.
     """
     if os.environ.get("SKYTPU_BENCH_NO_FALLBACK") == "1":
         return
@@ -84,8 +203,23 @@ def _probe_backend_or_fallback() -> None:
         return
     timeout = float(os.getenv("SKYTPU_BENCH_PROBE_TIMEOUT", "180"))
     attempts = int(os.getenv("SKYTPU_BENCH_PROBE_ATTEMPTS", "3"))
+    watcher_evidence = ""
+    dead_age = _last_dead_probe_age_s()
+    # 900 s ~= 1.5 watcher intervals: older means the watcher itself is
+    # probably dead, and a stale "hung" line must not shortcut the
+    # ladder (a revived tunnel would look identical in the log)
+    fresh_s = float(os.getenv("SKYTPU_BENCH_WATCH_FRESH_S", "900"))
+    if dead_age is not None and dead_age < fresh_s:
+        timeout = min(timeout, 60.0)
+        attempts = 1
+        watcher_evidence = (
+            f"; standing watcher logged a dead probe {dead_age:.0f}s ago "
+            f"(logs/tpu_watch.jsonl), so only one confirm probe was spent"
+        )
     last_failure = "unknown"
+    used = 0
     for attempt in range(1, attempts + 1):
+        used = attempt
         print(
             f"# probing accelerator backend (attempt {attempt}/{attempts}, "
             f"{timeout:.0f}s budget)...",
@@ -108,11 +242,16 @@ def _probe_backend_or_fallback() -> None:
             probe.kill()
             probe.wait()
             last_failure = f"probe hung >{timeout:.0f}s"
+        # never let probing eat the budget the measurement passes need
+        if _elapsed() > 0.4 * _DEADLINE_S:
+            last_failure += "; probe ladder stopped at 40% of wall budget"
+            break
         if attempt < attempts:
             time.sleep(min(10.0 * attempt, 30.0))
     reason = (
-        f"accelerator unresponsive after {attempts} probe attempts "
-        f"({last_failure}); measured on CPU with a scaled-down model"
+        f"accelerator unresponsive after {used} probe attempts "
+        f"({last_failure}){watcher_evidence}; measured on CPU with a "
+        f"scaled-down model"
     )
     print(f"# {reason}", file=sys.stderr, flush=True)
     env = dict(os.environ)
@@ -129,7 +268,7 @@ def _probe_backend_or_fallback() -> None:
     env.setdefault("SKYTPU_BENCH_BATCH", "16")
     env["SKYTPU_BENCH_NO_FALLBACK"] = "1"
     env["SKYTPU_BENCH_FALLBACK_REASON"] = reason
-    env["SKYTPU_BENCH_PROBE_ATTEMPTS_USED"] = str(attempts)
+    env["SKYTPU_BENCH_PROBE_ATTEMPTS_USED"] = str(used)
     os.execvpe(sys.executable, [sys.executable] + sys.argv, env)
 
 
@@ -141,18 +280,22 @@ import optax
 
 
 def _emit_mfu_artifact(note) -> None:
-    """Run tools/bench_mfu.py on the live accelerator; save MFU_r04.json."""
+    """Run tools/bench_mfu.py on the live accelerator; save MFU_r05.json."""
     if os.getenv("SKYTPU_BENCH_EMIT_MFU", "1") == "0":
         return
     root = os.path.dirname(os.path.abspath(__file__))
     note("live accelerator: running tools/bench_mfu.py for the MFU artifact")
     env = dict(os.environ)
-    env.setdefault("SKYTPU_MFU_JSON", os.path.join(root, "MFU_r04.json"))
+    env.setdefault("SKYTPU_MFU_JSON", os.path.join(root, "MFU_r05.json"))
     out_path = env["SKYTPU_MFU_JSON"]
     try:
         proc = subprocess.run(
             [sys.executable, os.path.join(root, "tools", "bench_mfu.py")],
-            env=env, timeout=float(os.getenv("SKYTPU_MFU_TIMEOUT", "1800")),
+            env=env,
+            timeout=min(
+                float(os.getenv("SKYTPU_MFU_TIMEOUT", "1800")),
+                max(_time_left() - 30.0, 60.0),
+            ),
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )
         for line in proc.stdout.splitlines():
@@ -213,8 +356,11 @@ def main() -> int:
     seq = 128
 
     def note(msg: str) -> None:
-        print(f"# [{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
-              flush=True)
+        print(
+            f"# [{time.strftime('%H:%M:%S')}] [{_time_left():.0f}s left] "
+            f"{msg}",
+            file=sys.stderr, flush=True,
+        )
 
     devices = jax.devices()
     note(f"backend up: {devices}")
@@ -224,6 +370,23 @@ def main() -> int:
     model_cfg = bert_layer_configs(
         cfg, num_encoder_units=layer_num, num_classes=3, deterministic=True,
         ffn_shards=ffn_shards,
+    )
+    mode = "sequential" if sequential else f"GPipe-M{n_micro}"
+    _RESULT.update(
+        metric=(
+            f"{len(model_cfg)}-unit stacked BERT-{preset} "
+            f"({layer_num} encoder layers, ffn/{ffn_shards}) "
+            f"{mode} step-time improvement, optimal vs even "
+            f"allocation, {n_workers} heterogeneous workers "
+            f"({slowdown_kind} slowdowns, {mem_regime} memory "
+            f"regime), measured on {devices[0].device_kind}"
+        ),
+        platform=platform,
+        device_kind=devices[0].device_kind,
+        probe_attempts=int(
+            os.getenv("SKYTPU_BENCH_PROBE_ATTEMPTS_USED", "0")
+        ),
+        fallback_reason=os.getenv("SKYTPU_BENCH_FALLBACK_REASON"),
     )
 
     slowdowns = worker_slowdowns(n_workers, slowdown_kind)
@@ -239,6 +402,11 @@ def main() -> int:
     data = (ids, types, mask)
 
     ps = ParameterServer(model_cfg, example_inputs=data, rng=jax.random.key(0))
+    # ONE optimizer object for every measurement pass: the stage-program
+    # cache keys on (slice structure, id(optimizer)), so a fresh optax
+    # object per pass would defeat cross-pass reuse of compiled programs —
+    # exactly the r04 wall-time blowup (VERDICT r04 task #2)
+    optimizer = optax.sgd(1e-3)
 
     # one ModelBenchmarker shared by both allocations (config-hash cached)
     # — its profile also feeds the memory-budget helper.  Default profile
@@ -254,8 +422,10 @@ def main() -> int:
         timed=(profile_kind == "timed"),
     )
     note(f"model profile ({profile_kind})...")
+    t_prof0 = time.time()
     _, layer_mem = model_bench.benchmark()
-    note(f"model profile done: {len(layer_mem)} layers, "
+    profile_s = time.time() - t_prof0
+    note(f"model profile done in {profile_s:.0f}s: {len(layer_mem)} layers, "
          f"{sum(layer_mem) / 1024:.1f} GB total estimate")
     # raw per-worker budget per the chosen regime (default: the reference's
     # loose mem_limit=-1 probe world — see dynamics/headline.py); worker
@@ -277,13 +447,34 @@ def main() -> int:
         def memory_slowdown(self, rank):
             return float(mem_skew[rank])
 
-    def measure_current_allocation(wm, label, ps, n_repeats=None):
-        """Build the real pipeline for the CURRENT allocation, sanity-train
-        one step, measure raw per-stage times, and score the emulated
-        heterogeneous step time.  Worker slowdown fields are zeroed only
-        for the duration of the measurement (the schedule model applies
-        them to the measured times), then restored so a later
-        re-allocation still sees the heterogeneity config."""
+    last_pass_s = [0.0]  # duration of the most recent measurement pass
+    # Per-stage adaptive chaining (see measure_stage_times): big stages
+    # time one execution per sample, small stages chain up to 3 to
+    # amortize dispatch — a fixed inner count either wastes wall clock
+    # (r04's even pass: ~230 s of timed loops) or dispatch-biases the
+    # optimal side, whose stages are smaller than even's.  A tunneled
+    # accelerator keeps the fixed chain of 3: its dispatch latency is
+    # the thing being amortized, not measured.
+    inner_iters = "auto" if platform == "cpu" else 3
+
+    def solver_budget() -> float:
+        """Anneal wall budget for one solve: bounded so the (1-core)
+        escalating anneal can never eat the measurement passes' time —
+        r04's default 300 s cap overshot to 347 s on this instance."""
+        return float(
+            os.getenv("SKYTPU_BENCH_SOLVER_S",
+                      str(min(90.0, max(10.0, _time_left() * 0.06))))
+        )
+
+    def measure_current_allocation(wm, label, ps, n_repeats=None,
+                                   sanity=True):
+        """Build the real pipeline for the CURRENT allocation, optionally
+        sanity-train one step, measure raw per-stage times, and score the
+        emulated heterogeneous step time.  Worker slowdown fields are
+        zeroed only for the duration of the measurement (the schedule
+        model applies them to the measured times), then restored so a
+        later re-allocation still sees the heterogeneity config."""
+        t_pass0 = time.time()
         saved = {}
         stage_slowdowns = []
         for w in sorted(wm.worker_pool, key=lambda w: w.rank):
@@ -291,46 +482,88 @@ def main() -> int:
                 stage_slowdowns.append(float(w.extra_config["slowdown"]))
             saved[id(w)] = w.extra_config.get("slowdown", 1.0)
             w.extra_config["slowdown"] = 1.0
+        loss = None
         try:
             model = PipelineModel(
-                wm, ps, optax.sgd(1e-3), cross_entropy_loss, devices=devices
+                wm, ps, optimizer, cross_entropy_loss, devices=devices
             )
-            note(f"{label}: pipeline built ({len(model.stages)} stages); "
-                 f"running one sanity train step...")
-            # end-to-end sanity: the pipeline actually trains
-            loss = model.train_step(data, labels, rng=jax.random.key(0))
-            if not np.isfinite(loss):
-                raise RuntimeError(f"{label}: non-finite loss {loss}")
-            note(f"{label}: train step ok; measuring per-stage times...")
-            # pass wall time is dominated by the 64 stage compiles, not the
-            # timed loops — generous repeats are nearly free and shrink the
-            # run-to-run noise that otherwise feeds the refine calibration
+            if sanity:
+                note(f"{label}: pipeline built ({len(model.stages)} "
+                     f"stages); running one sanity train step...")
+                # end-to-end sanity: the pipeline actually trains
+                loss = model.train_step(data, labels, rng=jax.random.key(0))
+                if not np.isfinite(loss):
+                    raise RuntimeError(f"{label}: non-finite loss {loss}")
+                note(f"{label}: train step ok; measuring per-stage times...")
+            else:
+                note(f"{label}: pipeline built ({len(model.stages)} "
+                     f"stages); measuring per-stage times...")
+            # pass wall time is dominated by the stage compiles, not the
+            # timed loops — generous repeats are nearly free and shrink
+            # the run-to-run noise that otherwise feeds the refine
+            # calibration
             measured = model.measure_stage_times(
-                data, repeats=n_repeats or repeats, inner_iters=3
+                data, repeats=n_repeats or repeats,
+                inner_iters=inner_iters,
             )
         finally:
             for w in wm.worker_pool:
                 w.extra_config["slowdown"] = saved[id(w)]
         taus = [t * s for t, s in zip(measured, stage_slowdowns)]
         step = schedule_step_time(taus, n_micro, sequential)
+        loss_txt = f"{loss:.3f}" if loss is not None else "skipped"
         print(
-            f"# {label}: step={step:.4f}s loss={loss:.3f} layers="
+            f"# {label}: step={step:.4f}s loss={loss_txt} layers="
             f"{[len(w.model_config) for w in sorted(wm.worker_pool, key=lambda w: w.rank)]} "
             f"measured={[round(t, 4) for t in measured]} "
             f"slowdowns={stage_slowdowns}",
             file=sys.stderr,
         )
+        last_pass_s[0] = time.time() - t_pass0
+        note(f"{label}: pass took {last_pass_s[0]:.0f}s")
         return step, measured
+
+    def record_best(even_step, opt_step, gap, history, partial):
+        """Refresh the best-so-far JSON fields after every optimal-side
+        measurement, so a kill at any later point still reports a real
+        (if less-refined) number."""
+        speedup = (even_step - opt_step) / even_step * 100
+        _RESULT.update(
+            value=round(speedup, 2),
+            vs_baseline=round(speedup / 55.0, 4),
+            solver_gap=(
+                round(gap, 4) if gap is not None and np.isfinite(gap)
+                else None
+            ),
+            refine_steps=list(history),
+            partial=partial,
+        )
 
     # closed-loop refinement: measure -> recalibrate per-layer costs ->
     # re-solve (Allocator.refine_allocation), keeping the best emulated
-    # step time.  0 disables.  (3 iterations: the loop was still
-    # descending at 2 on the base-preset instance.)
-    refine_iters = int(os.getenv("SKYTPU_BENCH_REFINE", "3"))
+    # step time.  0 disables.  Iterations run only while the wall budget
+    # affords them (each costs ~one measurement pass).  Default 0 since
+    # the affine even-pass calibration landed: across the r05 trials the
+    # first solve IS the loop's fixed point (refine deltas +0.1%..+20%,
+    # never negative — pure measurement noise re-solved into worse
+    # allocations), so the passes go to lower-variance measurement
+    # instead: symmetric repeats on both sides and the even drift
+    # bracket below.  The closed loop remains available (env knob) and
+    # CI-tested (tests/test_dynamics.py) for instances whose profiles
+    # mispredict reality badly enough to need it.
+    refine_iters = int(os.getenv("SKYTPU_BENCH_REFINE", "0"))
+    # even-pass calibration mode: "affine" fits the slice-size-aware
+    # cost(slice) = a*sum(units) + b*|slice| model (r04 task #3 — the
+    # uniform per-slice rescale transferred poorly from even granularity
+    # to the solver's slices); "scale" is the r04 uniform rescale; "0"
+    # disables seeding entirely.
+    calib_mode = os.getenv("SKYTPU_BENCH_CALIBRATION", "affine")
+    calib_fit = None
 
     step_times = {}
     solver_gap = None  # certified optimality gap of the optimal allocation
     refine_history = []
+    final_remeasured = False
     for alloc_type in ("even", "optimal"):
         wm = WorkerManager()
         wm.load_worker_pool_from_config(
@@ -367,13 +600,18 @@ def main() -> int:
             note(f"{alloc_type}: allocation done")
             step_times[alloc_type], even_measured = (
                 measure_current_allocation(wm, alloc_type, ps,
-                                           n_repeats=repeats + 2)
+                                           n_repeats=repeats + 4,
+                                           sanity=False)
             )
             even_counts = [
                 len(w.model_config)
                 for w in sorted(wm.worker_pool, key=lambda w: w.rank)
                 if w.model_config
             ]
+            even_wm, even_pass_s = wm, last_pass_s[0]
+            _RESULT["partial"] = (
+                "even baseline measured; optimal pass did not complete"
+            )
             continue
 
         def snapshot_allocation():
@@ -388,58 +626,113 @@ def main() -> int:
                 w.order = order
                 w.rank = rank
 
-        if os.getenv("SKYTPU_BENCH_EVEN_CALIBRATION", "1") != "0":
+        if calib_mode == "affine":
             # seed the cost model from the even baseline's measured stage
-            # times (already taken): the isolated-unit profile misses
-            # slice-level fusion/cache effects, while the even pass
-            # measured every layer at deployment granularity — for free
-            note("optimal: calibrating per-layer costs from the even "
+            # times (already taken), slice-size-aware: the isolated-unit
+            # profile misses per-unit overhead that only shows up inside
+            # deployed slices, and a plain per-slice rescale learned at
+            # even granularity transfers poorly to the solver's slices
+            note("optimal: affine cost calibration from the even "
                  "baseline's measured stage times...")
+            a, b = allocator.calibrate_costs_affine(
+                even_counts, even_measured
+            )
+            calib_fit = {"mode": "affine", "a": a, "b": b}
+            note(f"optimal: fitted cost(slice) = {a:.4g}*sum(units) + "
+                 f"{b:.4g}*|slice|")
+        elif calib_mode != "0":
+            note("optimal: calibrating per-layer costs from the even "
+                 "baseline's measured stage times (uniform rescale)...")
             allocator.calibrate_costs(even_counts, even_measured)
-        allocator.optimal_allocate()
+            calib_fit = {"mode": "scale"}
+        t_solve0 = time.time()
+        allocator.optimal_allocate(max_time=solver_budget())
+        solve_s = time.time() - t_solve0
         solver_gap = allocator.last_result.optimality_gap
         note(f"{alloc_type}: allocation done")
         initial_step, measured = measure_current_allocation(
-            wm, alloc_type, ps
+            wm, alloc_type, ps, n_repeats=repeats + 4
         )
         best_step, best_gap = initial_step, solver_gap
         best_snap = snapshot_allocation()
         refine_history.append(round(best_step, 4))
+        record_best(step_times["even"], best_step, best_gap,
+                    refine_history,
+                    "initial optimal measured; refinement incomplete")
+        ran_refines = 0
         for it in range(1, refine_iters + 1):
+            # each refine costs ~one measurement pass (plus a cheap
+            # re-solve); never start one the budget can't absorb while
+            # still leaving room for the final re-measurement
+            need = 0.6 * last_pass_s[0] + solve_s \
+                + 0.45 * last_pass_s[0] + 60
+            if _time_left() < need:
+                note(f"refine stopped before iteration {it}: "
+                     f"{_time_left():.0f}s left < {need:.0f}s needed")
+                break
             # measured raw per-stage seconds calibrate the per-layer costs
             # (slice-level fusion/cache effects the per-unit profile cannot
             # see), then the solver re-runs on the calibrated instance
             note(f"optimal: refine iteration {it}/{refine_iters} "
                  f"(closed-loop re-solve on measured stage times)...")
-            allocator.refine_allocation(measured)
+            t_solve0 = time.time()
+            allocator.refine_allocation(
+                measured, max_time=solver_budget()
+            )
+            solve_s = time.time() - t_solve0
             gap = allocator.last_result.optimality_gap
             step, measured = measure_current_allocation(
-                wm, f"optimal+refine{it}", ps
+                wm, f"optimal+refine{it}", ps, sanity=False
             )
+            ran_refines = it
             refine_history.append(round(step, 4))
             if step < best_step:
                 best_step, best_gap = step, gap
                 best_snap = snapshot_allocation()
-        if refine_iters > 0:
+            record_best(step_times["even"], best_step, best_gap,
+                        refine_history,
+                        f"best of {it} refine iterations; final "
+                        f"re-measurement not yet run")
+        if ran_refines > 0 and _time_left() > 0.45 * last_pass_s[0] + 30:
             # SELECT on the (noisy) loop scores, but REPORT a fresh
             # measurement of whichever allocation won — reporting the min
             # over N draws (even the initial's, conditional on it beating
             # the refined scores) would bias the headline upward (winner's
-            # curse).  The fresh pass uses the same repeats+2 as even's.
+            # curse).  The fresh pass uses the same repeats+4 as even's,
+            # so both sides of the subtraction carry the same noise level.
             restore_allocation(best_snap)
             final_step, _ = measure_current_allocation(
-                wm, "optimal-selected", ps, n_repeats=repeats + 2
+                wm, "optimal-selected", ps, n_repeats=repeats + 4
             )
             refine_history.append(round(final_step, 4))
             step_times[alloc_type] = final_step
+            final_remeasured = True
         else:
+            if ran_refines > 0:
+                note("final re-measurement skipped: insufficient budget; "
+                     "reporting the best loop score")
             step_times[alloc_type] = best_step
         solver_gap = best_gap
 
+    # Drift bracket (default on): the even baseline is measured BEFORE
+    # the optimal pass, so monotone machine drift (thermal, background
+    # load) lands entirely on one side of the subtraction — the r05
+    # trials saw the even step wander 14.09 -> 15.16 s across runs.  A
+    # second even measurement AFTER the optimal pass (cheap: every
+    # stage program is cache-warm) brackets the optimal epoch; the
+    # baseline is their mean, and both values ship in the artifact.
+    even_steps = [round(step_times["even"], 4)]
+    if (os.getenv("SKYTPU_BENCH_EVEN_BRACKET", "1") != "0"
+            and _time_left() > 0.5 * even_pass_s + 30):
+        e2, _ = measure_current_allocation(
+            even_wm, "even-recheck", ps, n_repeats=repeats + 4,
+            sanity=False,
+        )
+        even_steps.append(round(e2, 4))
+        step_times["even"] = (step_times["even"] + e2) / 2.0
     speedup_pct = (
         (step_times["even"] - step_times["optimal"]) / step_times["even"] * 100
     )
-    mode = "sequential" if sequential else f"GPipe-M{n_micro}"
 
     # ADVICE r03: the headline runs at ffn/2 granularity while vs_baseline
     # divides by the reference's 55% measured at 1/3-encoder granularity.
@@ -447,7 +740,8 @@ def main() -> int:
     # profile — same math evaluate_instance applies to the guard) so the
     # baseline comparison can be read at matching granularity.
     value_ffn1 = None
-    if os.getenv("SKYTPU_BENCH_EMIT_FFN1", "1") != "0" and ffn_shards != 1:
+    if (os.getenv("SKYTPU_BENCH_EMIT_FFN1", "1") != "0" and ffn_shards != 1
+            and _time_left() > profile_s * 1.3 + 45):
         from skycomputing_tpu.dynamics.headline import evaluate_instance
 
         note("ffn/1 reference-granularity number (schedule model on the "
@@ -470,46 +764,49 @@ def main() -> int:
         value_ffn1 = round(out1["speedup_pct"], 2)
         note(f"ffn/1 granularity: {value_ffn1}% "
              f"(gap {out1['solver_result'].optimality_gap:.4f})")
+    elif ffn_shards != 1:
+        note("ffn/1 side number skipped (budget or env)")
+    _RESULT.update(
+        value=round(speedup_pct, 2),
+        vs_baseline=round(speedup_pct / 55.0, 4),
+        # non-finite gap (lower bound <= 0) must serialize as null,
+        # not the invalid-JSON token Infinity
+        solver_gap=(
+            round(solver_gap, 4) if solver_gap is not None
+            and np.isfinite(solver_gap) else None
+        ),
+        # measured emulated step times per closed-loop iteration
+        # (optimal, then each refine_allocation re-solve)
+        refine_steps=refine_history,
+        even_steps=even_steps,
+        final_remeasure=final_remeasured,
+        calibration=calib_fit,
+        # reference-granularity (ffn/1) speedup via the schedule
+        # model on the timed ffn/1 profile — apples-to-apples with
+        # the reference's 1/3-encoder allocation units
+        value_ffn1_model=value_ffn1,
+        partial=None,
+    )
+    # emit FIRST: the headline line must not be hostage to the MFU side
+    # artifact (a subprocess whose own timeout could outlive the alarm
+    # backstop and downgrade a complete run to 'partial')
+    _emit()
     if platform != "cpu":
         _emit_mfu_artifact(note)
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"{len(model_cfg)}-unit stacked BERT-{preset} "
-                    f"({layer_num} encoder layers, ffn/{ffn_shards}) "
-                    f"{mode} step-time improvement, optimal vs even "
-                    f"allocation, {n_workers} heterogeneous workers "
-                    f"({slowdown_kind} slowdowns, {mem_regime} memory "
-                    f"regime), measured on {devices[0].device_kind}"
-                ),
-                "value": round(speedup_pct, 2),
-                "unit": "percent",
-                "vs_baseline": round(speedup_pct / 55.0, 4),
-                # non-finite gap (lower bound <= 0) must serialize as null,
-                # not the invalid-JSON token Infinity
-                "solver_gap": (
-                    round(solver_gap, 4) if solver_gap is not None
-                    and np.isfinite(solver_gap) else None
-                ),
-                # measured emulated step times per closed-loop iteration
-                # (optimal, then each refine_allocation re-solve)
-                "refine_steps": refine_history,
-                # reference-granularity (ffn/1) speedup via the schedule
-                # model on the timed ffn/1 profile — apples-to-apples with
-                # the reference's 1/3-encoder allocation units
-                "value_ffn1_model": value_ffn1,
-                "platform": platform,
-                "device_kind": devices[0].device_kind,
-                "probe_attempts": int(
-                    os.getenv("SKYTPU_BENCH_PROBE_ATTEMPTS_USED", "0")
-                ),
-                "fallback_reason": os.getenv("SKYTPU_BENCH_FALLBACK_REASON"),
-            }
-        )
-    )
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except BaseException as e:  # noqa: BLE001 - the JSON line must appear
+        if not isinstance(e, SystemExit):
+            import traceback
+
+            traceback.print_exc()
+            _RESULT["partial"] = (
+                f"crashed: {type(e).__name__}: {e}"
+            )
+            _emit()
+            sys.exit(1)
+        raise

@@ -139,16 +139,19 @@ def long_bert_layer_configs(
     num_classes: int = 3,
     deterministic: bool = False,
     axis_name: str = "sp",
+    strategy: str = "ring",
 ) -> list:
     """Layer-config list with ring-attention heads (bodies/tails unchanged —
-    they are position-wise and shard over the sequence for free)."""
+    they are position-wise and shard over the sequence for free).
+    ``strategy`` selects the sequence-parallel attention: ``"ring"``
+    (neighbor ppermute) or ``"ulysses"`` (head all-to-all)."""
     cfg = _cfg(config)
     encoder = []
     for _ in range(num_encoder_units):
         encoder.append(
             dict(layer_type="LongBertLayer_Head", config=cfg.to_dict(),
                  deterministic=deterministic, mesh=mesh,
-                 axis_name=axis_name)
+                 axis_name=axis_name, strategy=strategy)
         )
         encoder.append(
             dict(layer_type="BertLayer_Body", config=cfg.to_dict(),

@@ -31,6 +31,7 @@ choose (bfloat16 by default for MXU-friendly matmuls).
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -79,7 +80,13 @@ def _split_microbatches(tree, num_microbatches: int, what: str = "microbatches")
 # object — two equal-hyperparameter optax objects have different ids and
 # do not share (optax transforms expose no reliable value-hash to key on).
 _PROGRAM_CACHE: Dict = {}
-PROGRAM_CACHE_MAX_ENTRIES = 64
+# 256 (was 64): the headline bench measures ~6 successive 64-stage
+# allocations in one process; their cumulative distinct slice structures
+# exceed 64, so the smaller bound evicted programs that the very next
+# pass re-compiled.  Env-tunable for memory-constrained hosts.
+PROGRAM_CACHE_MAX_ENTRIES = int(
+    os.environ.get("SKYTPU_PROGRAM_CACHE_MAX", "256")
+)
 
 
 def clear_program_cache() -> None:
@@ -643,8 +650,9 @@ class PipelineModel:
         data,
         rng: Optional[jax.Array] = None,
         repeats: int = 3,
-        inner_iters: int = 3,
+        inner_iters=3,
         dedup: bool = True,
+        auto_window_s: float = 0.5,
     ) -> List[float]:
         """Real per-stage forward+backward seconds on their devices.
 
@@ -655,6 +663,15 @@ class PipelineModel:
         the per-iteration figure.  This is the honest per-stage cost
         profile the pipelined step time is built from — per-call elapsed
         times inside a full step are polluted by queueing.
+
+        ``inner_iters="auto"`` sizes the chain per stage from a single
+        post-warm probe execution: ``clamp(round(auto_window_s / t1), 1,
+        3)``.  Fixed chaining either wastes wall clock on big stages
+        (inner=3 on a 2 s slice) or leaves small stages dispatch-biased
+        (inner=1 on a 0.2 s slice counts ~1-2% dispatch overhead as
+        compute) — and since an optimal allocation's stages are smaller
+        than an even allocation's, that bias systematically *understates*
+        the optimal-vs-even headline.
 
         ``dedup`` reuses the measurement of an earlier stage with the same
         (layer structure, input signature, physical device): deep pipelines
@@ -682,30 +699,35 @@ class PipelineModel:
                 acts = jax.tree_util.tree_map(np.asarray, out)
                 continue
             dy = jax.tree_util.tree_map(jnp.zeros_like, out)
-            # warm both programs
-            if stage._differentiable_inputs:
-                warm = stage._bwd(stage.params, inputs, stage_rng, dy)
-            else:
-                warm = stage._bwd_params_only(
+
+            def one_iter():
+                stage._fwd(stage.params, inputs, stage_rng)
+                if stage._differentiable_inputs:
+                    return stage._bwd(stage.params, inputs, stage_rng, dy)
+                return stage._bwd_params_only(
                     stage.params, inputs, stage_rng, dy
                 )
-            jax.block_until_ready(warm)
+
+            # warm both programs
+            jax.block_until_ready(one_iter())
+
+            if inner_iters == "auto":
+                t0 = time.perf_counter()
+                jax.block_until_ready(one_iter())
+                t1 = time.perf_counter() - t0
+                inner = max(1, min(3, round(auto_window_s / max(t1, 1e-9))))
+            else:
+                inner = int(inner_iters)
 
             samples = []
             for _ in range(repeats):
                 t0 = time.perf_counter()
                 g = None
-                for _ in range(inner_iters):
-                    stage._fwd(stage.params, inputs, stage_rng)
-                    if stage._differentiable_inputs:
-                        g = stage._bwd(stage.params, inputs, stage_rng, dy)
-                    else:
-                        g = stage._bwd_params_only(
-                            stage.params, inputs, stage_rng, dy
-                        )
+                for _ in range(inner):
+                    g = one_iter()
                 jax.block_until_ready(g)
                 samples.append(
-                    (time.perf_counter() - t0) / max(inner_iters, 1)
+                    (time.perf_counter() - t0) / max(inner, 1)
                 )
             t_stage = float(np.median(samples))
             seen[key] = t_stage

@@ -7,6 +7,7 @@ import jax
 import pytest
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_survives_axon_env():
     """dryrun_multichip must succeed even when the axon TPU plugin env is
     present and the tunnel is dead (round 1 scored rc=124 from exactly

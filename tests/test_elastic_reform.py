@@ -22,6 +22,9 @@ import sys
 import textwrap
 
 import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # two-process supervisor kill test
 
 _TRAINER = textwrap.dedent(
     """

@@ -118,6 +118,7 @@ _SURVIVOR = textwrap.dedent(
 )
 
 
+@pytest.mark.slow
 def test_two_process_peer_death_is_detected(tmp_path):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))

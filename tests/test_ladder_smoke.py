@@ -5,12 +5,14 @@ through ``tools/run_ladder.py`` exactly as the full artifact run does
 (``LADDER_r04.json``), at the tiny preset with reduced iterations.
 """
 
+import pytest
 import json
 import os
 import subprocess
 import sys
 
 
+@pytest.mark.slow
 def test_two_smallest_rungs_run_end_to_end(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     out_json = tmp_path / "ladder.json"

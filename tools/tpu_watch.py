@@ -8,9 +8,9 @@ evidence hole, so this watcher polls cheaply in the background and, the
 moment a probe completes, runs the full evidence batch at the largest
 single-chip preset and leaves committed-ready artifacts:
 
-    MFU_r04.json     (tools/bench_mfu.py)
-    KV_r04.json      (tools/bench_kv_cache.py stdout capture)
-    BENCH_tpu_r04.json  (bench.py single JSON line)
+    MFU_r05.json     (tools/bench_mfu.py)
+    KV_r05.json      (tools/bench_kv_cache.py stdout capture)
+    BENCH_tpu_r05.json  (bench.py single JSON line)
 
 Every probe attempt is appended to ``logs/tpu_watch.jsonl`` either way —
 the probe log is itself the artifact proving the tunnel never answered
@@ -85,7 +85,7 @@ def run_evidence_batch(info: dict) -> None:
         (
             "mfu",
             [sys.executable, os.path.join(ROOT, "tools", "bench_mfu.py")],
-            dict(env, SKYTPU_MFU_JSON=os.path.join(ROOT, "MFU_r04.json")),
+            dict(env, SKYTPU_MFU_JSON=os.path.join(ROOT, "MFU_r05.json")),
             3600,
         ),
         (
@@ -100,8 +100,12 @@ def run_evidence_batch(info: dict) -> None:
             [sys.executable, os.path.join(ROOT, "bench.py")],
             # no CPU fallback: if the tunnel flaps mid-batch the bench must
             # fail, not silently record a CPU number as a "TPU" artifact
+            # match bench's internal deadline to this 7200 s budget — its
+            # driver-default 1680 s would self-truncate a live-TPU run and
+            # stamp a 'partial' record as the headline TPU artifact
             dict(env, SKYTPU_BENCH_EMIT_MFU="0",
-                 SKYTPU_BENCH_NO_FALLBACK="1"),
+                 SKYTPU_BENCH_NO_FALLBACK="1",
+                 SKYTPU_BENCH_DEADLINE_S="7000"),
             7200,
         ),
     ]
@@ -116,7 +120,7 @@ def run_evidence_batch(info: dict) -> None:
             log_event({"run": name, "rc": proc.returncode,
                        "tail": tail})
             if name == "kv_cache" and proc.returncode == 0:
-                with open(os.path.join(ROOT, "KV_r04.json"), "w") as fh:
+                with open(os.path.join(ROOT, "KV_r05.json"), "w") as fh:
                     json.dump({"tool": "bench_kv_cache",
                                "device": info, "stdout": proc.stdout}, fh,
                               indent=2)
@@ -128,8 +132,9 @@ def run_evidence_batch(info: dict) -> None:
                     record = json.loads(last[-1]) if last else None
                 except ValueError:
                     pass
-                if record and record.get("platform") not in (None, "cpu"):
-                    with open(os.path.join(ROOT, "BENCH_tpu_r04.json"),
+                if (record and record.get("platform") not in (None, "cpu")
+                        and not record.get("partial")):
+                    with open(os.path.join(ROOT, "BENCH_tpu_r05.json"),
                               "w") as fh:
                         fh.write(last[-1] + "\n")
                 else:

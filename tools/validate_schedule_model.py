@@ -64,8 +64,12 @@ def schedule_step_time(taus, M: int) -> float:
     return float(taus.sum() / M + (M - 1) / M * taus.max())
 
 
-def validate_composition(devices, serial: bool) -> float:
-    """Measured end-to-end MPMD train_step vs the tau-built model."""
+def validate_composition(devices, serial: bool, preset: str = "base") -> float:
+    """Measured end-to-end MPMD train_step vs the tau-built model.
+
+    ``preset`` scales the model: the artifact run uses "base"; the CI
+    smoke (tests/test_schedule_model.py) uses "tiny" for wall time.
+    """
     from skycomputing_tpu.dynamics import (
         Allocator,
         ParameterServer,
@@ -77,7 +81,7 @@ def validate_composition(devices, serial: bool) -> float:
 
     n_stages = min(4, len(devices))
     cfg = bert_config(
-        "base", dtype="float32", hidden_dropout_prob=0.0,
+        preset, dtype="float32", hidden_dropout_prob=0.0,
         attention_probs_dropout_prob=0.0,
     )
     model_cfg = bert_layer_configs(cfg, num_encoder_units=n_stages * 2,
@@ -226,6 +230,31 @@ def main() -> int:
           f"composition delta {d1 * 100:.1f}%, "
           f"fill-drain worst delta {d2 * 100:.1f}% -> "
           f"{'OK (<15%)' if ok else 'FAIL (>=15%)'}", flush=True)
+    out_path = os.environ.get("SKYTPU_SCHEDVAL_JSON")
+    if out_path:
+        import json
+        import datetime
+
+        with open(out_path, "w") as fh:
+            json.dump(
+                {
+                    "composition_delta_pct": round(d1 * 100, 2),
+                    "fill_drain_worst_delta_pct": round(d2 * 100, 2),
+                    "serial_devices": bool(serial),
+                    "concurrency_ratio": round(ratio, 3),
+                    "platform": devices[0].platform,
+                    "device_kind": devices[0].device_kind,
+                    "n_devices": len(devices),
+                    "threshold_pct": 15.0,
+                    "ok": bool(ok),
+                    "ts": datetime.datetime.now().isoformat(
+                        timespec="seconds"
+                    ),
+                },
+                fh, indent=1,
+            )
+            fh.write("\n")
+        print(f"schedule validation artifact -> {out_path}", flush=True)
     return 0 if ok else 1
 
 
