@@ -236,6 +236,76 @@ class Allocator:
         self._cost_override = [a * c + b for c in costs]
         return a, b
 
+    def calibrate_costs_by_type(
+        self, stage_layer_counts, measured_stage_times
+    ):
+        """Fit one cost per distinct UNIT TYPE from measured stage times.
+
+        The affine fit (:meth:`calibrate_costs_affine`) keeps the noisy
+        single-draw timed per-unit profile in its feature (``sum of unit
+        costs``), so its parameters — especially the per-unit overhead
+        term — swing run to run and the solver's allocation swings with
+        them.  Deep stacked models have only a handful of distinct unit
+        configs (the program cache dedups on exactly this), so the
+        measured stages give a small well-posed regression
+
+            t_stage  ≈  sum_type  count(stage, type) * c_type
+
+        whose ONLY stochastic input is the stage-time medians — the
+        per-unit profile drops out of the solve entirely.  Negative
+        solutions are clamped to zero and the remainder refit
+        (active-set) so the override stays a valid additive cost model.
+
+        Returns ``{type_json: cost}`` for provenance.
+        """
+        import json as _json
+
+        import numpy as np
+
+        if len(stage_layer_counts) != len(measured_stage_times):
+            raise ValueError(
+                f"{len(measured_stage_times)} measured times for "
+                f"{len(stage_layer_counts)} stages"
+            )
+        if sum(stage_layer_counts) != len(self._model_cfg):
+            raise ValueError(
+                f"stage slices cover {sum(stage_layer_counts)} layers, "
+                f"model has {len(self._model_cfg)}"
+            )
+        type_of = [
+            _json.dumps(cfg, sort_keys=True, default=str)
+            for cfg in self._model_cfg
+        ]
+        types = sorted(set(type_of))
+        tindex = {t: i for i, t in enumerate(types)}
+        A = np.zeros((len(stage_layer_counts), len(types)))
+        pos = 0
+        for j, n in enumerate(stage_layer_counts):
+            for i in range(pos, pos + n):
+                A[j, tindex[type_of[i]]] += 1.0
+            pos += n
+        y = np.asarray(measured_stage_times, dtype=np.float64)
+        active = list(range(len(types)))
+        c = np.zeros(len(types))
+        for _ in range(len(types) + 1):
+            if not active:
+                break
+            sol, *_ = np.linalg.lstsq(A[:, active], y, rcond=None)
+            neg = [k for k, v in zip(active, sol) if v < 0.0]
+            for k, v in zip(active, sol):
+                c[k] = max(v, 0.0)
+            if not neg:
+                break
+            active = [k for k in active if k not in neg]
+        # a zero-cost type would be "free" to the solver (degenerate
+        # packing); floor clamped types at 5% of the median fitted cost
+        positive = [v for v in c if v > 0.0]
+        if positive:
+            floor = 0.05 * float(np.median(positive))
+            c = np.maximum(c, floor)
+        self._cost_override = [float(c[tindex[t]]) for t in type_of]
+        return {t: float(c[tindex[t]]) for t in types}
+
     def refine_allocation(
         self, measured_stage_times, damping: float = 0.5,
         max_time: float = 300,

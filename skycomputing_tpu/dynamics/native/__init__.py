@@ -166,10 +166,11 @@ def solve_large_native(
     fixed-order walk achieves, anneals over orders (swap / move /
     bottleneck-targeted swap proposals, eval-count rounds with doubling
     budgets), and hill-climbs slice boundaries on every improvement —
-    the same search the pure-Python fallback runs, at ~10^4 x the
-    evaluation rate.  Deterministic per seed; the wall cap is consulted
-    at round boundaries only.  None if the library is unavailable;
-    RuntimeError when no explored order covers the model.
+    the same search the pure-Python fallback runs, at a far higher
+    evaluation rate.  Deterministic per seed whenever the eval budget
+    completes inside ``wall_cap_s`` (under a binding cap an in-round
+    check truncates with sub-second overshoot).  None if the library is
+    unavailable; RuntimeError when no explored order covers the model.
     """
     lib = load()
     if lib is None:

@@ -475,16 +475,25 @@ def solve_contiguous_minmax(
         # Python path too — the native call then runs only the initial
         # sorted-order score + boundary polish (milliseconds)
         anneal_on = anneal_seconds > 0 and anneal_evals > 0
-        solved = native.solve_large_native(
-            layer_cost, layer_mem, device_time, device_mem,
-            seed=seed,
-            rounds=max(anneal_rounds, 1) if anneal_on else 0,
-            evals0=max(anneal_evals * 20, 20000),
-            wall_cap_s=anneal_seconds if anneal_on else 0.0,
-            lower_bound=lower_bound,
-            gap_target=gap_target,
-            tolerance=tolerance,
-        )
+        try:
+            solved = native.solve_large_native(
+                layer_cost, layer_mem, device_time, device_mem,
+                seed=seed,
+                rounds=max(anneal_rounds, 1) if anneal_on else 0,
+                evals0=max(anneal_evals * 20, 20000),
+                wall_cap_s=anneal_seconds if anneal_on else 0.0,
+                lower_bound=lower_bound,
+                gap_target=gap_target,
+                tolerance=tolerance,
+            )
+        except RuntimeError:
+            # the native feasibility probe (sorted order + random
+            # restarts, greedy walk) is weaker than the Python
+            # _feasible_greedy's max-coverage device selection on
+            # fragmented-memory instances — fall through rather than
+            # declare a solvable instance infeasible; the Python path
+            # raises its own error if it truly cannot cover the model
+            solved = None
         if solved is not None:
             order, slices, bottleneck = solved
             return PartitionResult(order, [list(s) for s in slices],

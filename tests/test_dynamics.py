@@ -501,3 +501,64 @@ def test_calibrate_costs_affine_rejects_mismatches():
         alloc.calibrate_costs_affine([4, 4], [1.0])
     with pytest.raises(ValueError):
         alloc.calibrate_costs_affine([4, 3], [1.0, 2.0])
+
+
+def test_calibrate_costs_by_type_recovers_type_costs():
+    """When reality is per-type additive, the regression recovers the
+    type costs exactly from slice sums — the calibration the headline
+    bench defaults to (its only stochastic input is the stage medians)."""
+    # 12 units alternating two types (Dense features 8 / 16)
+    cfg_a = dict(layer_type="Dense", features=8)
+    cfg_b = dict(layer_type="Dense", features=16)
+    model_cfg = [cfg_a, cfg_b] * 6
+    wm = make_worker_manager(3)
+    alloc = Allocator(
+        model_cfg, wm,
+        FakeModelBenchmarker([1.0] * 12, [0.1] * 12),
+        FakeDeviceBenchmarker([1.0, 1.0, 2.0], [1000.0] * 3, wm=wm),
+    )
+    true_cost = {str(8): 0.3, str(16): 0.7}
+    counts = [3, 4, 5]
+    measured, pos = [], 0
+    for n in counts:
+        t = sum(
+            true_cost[str(model_cfg[i]["features"])]
+            for i in range(pos, pos + n)
+        )
+        measured.append(t)
+        pos += n
+    fit = alloc.calibrate_costs_by_type(counts, measured)
+    assert len(fit) == 2
+    got = sorted(fit.values())
+    assert abs(got[0] - 0.3) < 1e-9 and abs(got[1] - 0.7) < 1e-9
+    # override maps each unit to its type cost
+    for cfg, c in zip(model_cfg, alloc._cost_override):
+        assert abs(c - true_cost[str(cfg["features"])]) < 1e-9
+
+
+def test_calibrate_costs_by_type_clamps_and_floors():
+    """Degenerate fits must not hand the solver free (zero-cost) units."""
+    cfg_a = dict(layer_type="Dense", features=8)
+    cfg_b = dict(layer_type="Dense", features=16)
+    model_cfg = [cfg_a] * 6 + [cfg_b] * 2
+    wm = make_worker_manager(2)
+    alloc = Allocator(
+        model_cfg, wm,
+        FakeModelBenchmarker([1.0] * 8, [0.1] * 8),
+        FakeDeviceBenchmarker([1.0, 1.0], [1000.0] * 2, wm=wm),
+    )
+    # measurements that imply a negative cost for type b
+    alloc.calibrate_costs_by_type([6, 2], [6.0, 0.01])
+    assert all(c > 0.0 for c in alloc._cost_override)
+
+
+def test_calibrate_costs_by_type_rejects_mismatches():
+    import pytest
+
+    alloc, _ = _make_allocator(
+        [1.0, 2.0], [1000.0] * 2, [1.0] * 8, [0.1] * 8, n_layers=8
+    )
+    with pytest.raises(ValueError):
+        alloc.calibrate_costs_by_type([4, 4], [1.0])
+    with pytest.raises(ValueError):
+        alloc.calibrate_costs_by_type([4, 3], [1.0, 2.0])
