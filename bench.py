@@ -90,6 +90,12 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# The bench's ~6 successive 64-stage allocations hold >64 distinct slice
+# structures; the library's default cache would evict programs the very
+# next pass re-compiles (r04's wall-clock blowup).  Set before the
+# package import so the module-level cap picks it up.
+os.environ.setdefault("SKYTPU_PROGRAM_CACHE_MAX", "256")
+
 # Wall budget counted from the FIRST process start: the CPU-fallback
 # re-exec below replaces the process, so T0 rides an env var.
 # 1680 s = 28 min: the driver's observed kill budget is ~30 min (r04 was

@@ -80,12 +80,15 @@ def _split_microbatches(tree, num_microbatches: int, what: str = "microbatches")
 # object — two equal-hyperparameter optax objects have different ids and
 # do not share (optax transforms expose no reliable value-hash to key on).
 _PROGRAM_CACHE: Dict = {}
-# 256 (was 64): the headline bench measures ~6 successive 64-stage
-# allocations in one process; their cumulative distinct slice structures
-# exceed 64, so the smaller bound evicted programs that the very next
-# pass re-compiled.  Env-tunable for memory-constrained hosts.
-PROGRAM_CACHE_MAX_ENTRIES = int(
-    os.environ.get("SKYTPU_PROGRAM_CACHE_MAX", "256")
+# Default 64.  The headline bench raises this to 256 via
+# SKYTPU_PROGRAM_CACHE_MAX (its successive 64-stage allocations exceed 64
+# distinct slice structures, and re-compiles dominated its wall clock) —
+# but a LARGER default is hostile to long-lived many-model processes:
+# each entry pins jitted executables (mapped code pages), and a full
+# test-suite process at cap 256 accumulated enough mappings to segfault
+# XLA's compiler ~50 min in (r05; cap 64 had always been stable).
+PROGRAM_CACHE_MAX_ENTRIES = max(
+    1, int(os.environ.get("SKYTPU_PROGRAM_CACHE_MAX", "64"))
 )
 
 
