@@ -11,8 +11,15 @@ from .solver import PartitionResult, solve_contiguous_minmax
 from .worker import Worker
 from .worker_manager import WorkerManager
 
+# imported last: faults.py reaches into ..runner for the Hook base, and
+# runner.runner imports the names above from this (then partially
+# initialized) module
+from .faults import FaultInjectionHook, FaultPlan  # noqa: E402
+
 __all__ = [
     "Allocator",
+    "FaultInjectionHook",
+    "FaultPlan",
     "BaseBenchmarker",
     "DeviceBenchmarker",
     "ModelBenchmarker",

@@ -40,6 +40,11 @@ class Allocator:
         self._model_benchmarker = model_benchmarker
         self._device_benchmarker = device_benchmarker
         self._logger = logger or Logger()
+        self._cost_override: Optional[List[float]] = None
+        # worker.id -> multiplicative device-speed correction, learned from
+        # live training telemetry (calibrate_device_speeds).  Keyed by the
+        # worker's stable id, not rank: allocation re-ranks the pool.
+        self._speed_override: Dict[str, float] = {}
 
     # ------------------------------------------------------------------ util
     def _profiles(self):
@@ -54,6 +59,13 @@ class Allocator:
         perf = list(device_results.values())
         device_time = [p["time"] for p in perf]
         device_mem = [p["avai_mem"] for p in perf]
+        if getattr(self, "_speed_override", None):
+            device_time = [
+                t * self._speed_override.get(
+                    self._worker_manager.get_by_rank(r).id, 1.0
+                )
+                for r, t in zip(worker_ranks, device_time)
+            ]
         return worker_ranks, device_time, device_mem, layer_flops, layer_mem
 
     def _apply_partition(
@@ -306,9 +318,117 @@ class Allocator:
         self._cost_override = [float(c[tindex[t]]) for t in type_of]
         return {t: float(c[tindex[t]]) for t in types}
 
+    # ------------------------------------------- device-speed calibration
+    def _ordered_stage_workers(self, measured_stage_times) -> List:
+        """Non-empty workers in pipeline order, validated against the
+        measurement list length."""
+        workers = sorted(
+            (w for w in self._worker_manager.worker_pool if w.model_config),
+            key=lambda w: w.order,
+        )
+        if len(workers) != len(measured_stage_times):
+            raise ValueError(
+                f"{len(measured_stage_times)} measured times for "
+                f"{len(workers)} non-empty stages"
+            )
+        return workers
+
+    def stage_divergence(self, measured_stage_times) -> Dict[int, float]:
+        """Per-worker measured/modeled stage-time ratio, median-normalized.
+
+        For each non-empty stage (pipeline order), the cost model predicts
+        ``device_time[worker] * sum(layer costs in slice)``; the ratio of
+        the MEASURED stage time to that prediction, divided by the median
+        ratio across stages (which absorbs the model's arbitrary global
+        units), isolates per-DEVICE anomalies: a healthy calibrated world
+        reads ~1.0 everywhere, a 3x-degraded node reads ~3.0.  Keyed by
+        the worker's stable ``stim_index`` so the figure survives
+        re-ranking and process restarts (worker uuids don't).
+        """
+        workers = self._ordered_stage_workers(measured_stage_times)
+        worker_ranks, device_time, _, layer_flops, _ = self._profiles()
+        dt = dict(zip(worker_ranks, device_time))
+        raw: Dict[int, float] = {}
+        pos = 0
+        for w, t in zip(workers, measured_stage_times):
+            n = len(w.model_config)
+            pred = dt[w.rank] * sum(layer_flops[pos:pos + n])
+            raw[w.stim_index] = float(t) / pred if pred > 0 and t > 0 else 1.0
+            pos += n
+        if pos != len(layer_flops):
+            raise ValueError(
+                f"stage slices cover {pos} layers, model has "
+                f"{len(layer_flops)}"
+            )
+        ratios = sorted(raw.values())
+        mid = len(ratios) // 2
+        median = (
+            ratios[mid]
+            if len(ratios) % 2
+            else 0.5 * (ratios[mid - 1] + ratios[mid])
+        )
+        if median <= 0:
+            return {k: 1.0 for k in raw}
+        return {k: v / median for k, v in raw.items()}
+
+    def calibrate_device_speeds(
+        self, measured_stage_times, damping: float = 1.0
+    ) -> Dict[int, float]:
+        """Fold measured per-stage divergence into the DEVICE model.
+
+        ``calibrate_costs`` attributes measured/predicted gaps to the
+        LAYERS of each slice — right for slice-size effects (fusion,
+        cache), wrong for a degraded node: rescaled layers stay expensive
+        wherever the re-solve moves them, so the solver never routes work
+        AWAY from the slow device.  This pass attributes the gap to the
+        DEVICE instead (multiplying its modeled time by the normalized
+        divergence), which is exactly the straggler model.  Multiplicative
+        and keyed by stable worker id, so repeated calibrations converge:
+        once the override matches reality the divergence reads 1.0.
+
+        Returns the stim_index-keyed divergence ratios for provenance.
+        """
+        ratios = self.stage_divergence(measured_stage_times)
+        for w in self._worker_manager.worker_pool:
+            if w.stim_index in ratios:
+                scale = ratios[w.stim_index] ** float(damping)
+                self._speed_override[w.id] = (
+                    self._speed_override.get(w.id, 1.0) * scale
+                )
+        return ratios
+
+    def device_scales(self) -> Dict[int, float]:
+        """The CUMULATIVE device-speed override, keyed by stable
+        ``stim_index`` — the serializable form of everything this
+        allocator has learned about node degradation.  This (not a single
+        round's divergence) is what must cross a process boundary: a
+        relaunched trainer starts with a fresh override, so staging only
+        the latest measurement would silently drop every earlier
+        correction."""
+        return {
+            w.stim_index: self._speed_override[w.id]
+            for w in self._worker_manager.worker_pool
+            if w.id in self._speed_override
+        }
+
+    def apply_device_scales(self, scales: Dict) -> None:
+        """Seed the device-speed override from a serialized map
+        (``{stim_index: scale}``, int or str keys — JSON round-trips
+        stringify them).  This is how a re-formed elastic world carries a
+        self-heal measurement across the process boundary: the exiting
+        trainer stages the scales through the rendezvous payload and the
+        relaunched trainer applies them before its first allocation."""
+        by_index = {int(k): float(v) for k, v in scales.items()}
+        for w in self._worker_manager.worker_pool:
+            if w.stim_index in by_index:
+                self._speed_override[w.id] = (
+                    self._speed_override.get(w.id, 1.0)
+                    * by_index[w.stim_index]
+                )
+
     def refine_allocation(
         self, measured_stage_times, damping: float = 0.5,
-        max_time: float = 300,
+        max_time: float = 300, attribute: str = "layers",
     ) -> WorkerManager:
         """Re-allocate with per-layer costs calibrated to MEASURED stage
         times — closed-loop allocation.
@@ -332,21 +452,30 @@ class Allocator:
         allocations — slice-level scales are applied uniformly to a
         slice's layers, so re-solved boundaries re-mix them — while a
         damped update contracts toward a fixed point.
+
+        ``attribute`` picks where the measured/modeled gap lands:
+        ``"layers"`` (default, the historical behavior) rescales the
+        slice's layer costs — right for slice-size effects; ``"devices"``
+        rescales the owning device's modeled speed
+        (:meth:`calibrate_device_speeds`) — right for a degraded node,
+        which is the self-healing runtime's case.
         """
-        workers = sorted(
-            (w for w in self._worker_manager.worker_pool if w.model_config),
-            key=lambda w: w.order,
-        )
-        if len(workers) != len(measured_stage_times):
-            raise ValueError(
-                f"{len(measured_stage_times)} measured times for "
-                f"{len(workers)} non-empty stages"
+        if attribute == "devices":
+            # validates the measurement list itself (stage_divergence)
+            self.calibrate_device_speeds(
+                measured_stage_times, damping=damping
             )
-        self.calibrate_costs(
-            [len(w.model_config) for w in workers],
-            measured_stage_times,
-            damping=damping,
-        )
+        elif attribute == "layers":
+            workers = self._ordered_stage_workers(measured_stage_times)
+            self.calibrate_costs(
+                [len(w.model_config) for w in workers],
+                measured_stage_times,
+                damping=damping,
+            )
+        else:
+            raise ValueError(
+                f"unknown attribute {attribute!r}; use 'layers' or 'devices'"
+            )
         return self.optimal_allocate(max_time=max_time)
 
     # --------------------------------------------------------------- dynamic

@@ -21,6 +21,8 @@ import numpy as np
 from flax import serialization
 
 from ..builder import build_layer_stack
+from ..utils.fileio import atomic_write
+from ..utils.retry import retry_call
 
 
 class ParameterServer:
@@ -61,12 +63,43 @@ class ParameterServer:
         return serialization.msgpack_serialize({"layers": host_params})
 
     def save_weights_to_file(self, checkpoint: str) -> None:
-        with open(checkpoint, "wb") as fh:
-            fh.write(self.state_bytes())
+        """Crash-safe single-file save: write ``checkpoint + ".tmp"`` then
+        atomically publish with ``os.replace`` (the same pattern
+        ``FileRendezvous.form_world`` uses for ``world.json``).  A crash —
+        or a ``kill -9`` — at ANY point before the replace leaves the
+        previous checkpoint intact as the newest complete file; a torn
+        half-written file can never shadow a good one."""
+        blob = self.state_bytes()
+        retry_call(lambda: atomic_write(checkpoint, blob),
+                   retry_on=(OSError,),
+                   describe=f"checkpoint save {checkpoint}")
 
     def load_weights_from_file(self, checkpoint: str) -> None:
-        with open(checkpoint, "rb") as fh:
-            restored = serialization.msgpack_restore(fh.read())
+        if not os.path.exists(checkpoint):
+            # a deterministically missing file fails fast: only reads of
+            # an EXISTING checkpoint get the transient-fault retries
+            raise FileNotFoundError(f"no checkpoint at {checkpoint!r}")
+
+        def read():
+            with open(checkpoint, "rb") as fh:
+                return fh.read()
+
+        raw = retry_call(read, retry_on=(OSError,),
+                         describe=f"checkpoint read {checkpoint}")
+        try:
+            restored = serialization.msgpack_restore(raw)
+        except Exception as exc:
+            # a truncated / torn msgpack otherwise surfaces as a deep
+            # unpacker traceback with no mention of which file was bad
+            raise ValueError(
+                f"corrupt or truncated checkpoint {checkpoint!r} "
+                f"({len(raw)} bytes): {exc}"
+            ) from exc
+        if not isinstance(restored, dict) or "layers" not in restored:
+            raise ValueError(
+                f"corrupt or truncated checkpoint {checkpoint!r}: no "
+                f"'layers' entry (got {type(restored).__name__})"
+            )
         layers = restored["layers"]
         if isinstance(layers, dict):  # msgpack may round-trip lists as dicts
             layers = [layers[k] for k in sorted(layers, key=int)]

@@ -58,7 +58,14 @@ class WorkerManager:
 
     def remove_worker_by_id(self, id_str: str) -> None:
         worker = self.get_by_id(id_str)
-        assert not worker.is_running, f"Worker {id_str} is still running"
+        if worker.is_running:
+            # a real error, not an assert: under ``python -O`` asserts
+            # vanish and a running worker would be silently dropped from
+            # the pool while its stage still executes
+            raise RuntimeError(
+                f"Worker {id_str} is still running; stop it before "
+                f"removing it from the pool"
+            )
         self._worker_pool.remove(worker)
         self._allocate_rank()
 

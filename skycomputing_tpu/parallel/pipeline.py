@@ -684,6 +684,15 @@ class PipelineModel:
         The untimed chained forward still runs once per stage to produce
         the next stage's inputs.
 
+        Each reported time is multiplied by the stage's ``slowdown``
+        factor (the emulated-degradation knob ``StageRuntime`` applies in
+        ``train_step``): the raw jitted programs timed here bypass the
+        slowdown sleep, so without the multiplier a fault-injected or
+        stimulator-emulated straggler would be invisible to exactly the
+        measurement pass the self-healing re-allocation relies on.  The
+        dedup cache stores RAW times, so stages sharing programs but
+        emulating different node speeds stay distinct.
+
         ``seed_times``: optional cross-call (key -> seconds) map.  Keys
         present are trusted as prior measurements (only the untimed
         forward runs for those stages); new measurements are written
@@ -708,7 +717,7 @@ class PipelineModel:
                 stage.device,
             )
             if dedup and key in seen:
-                times.append(seen[key])
+                times.append(seen[key] * max(stage.slowdown, 1.0))
                 acts = jax.tree_util.tree_map(np.asarray, out)
                 continue
             dy = jax.tree_util.tree_map(jnp.zeros_like, out)
@@ -744,7 +753,7 @@ class PipelineModel:
                 )
             t_stage = float(np.median(samples))
             seen[key] = t_stage
-            times.append(t_stage)
+            times.append(t_stage * max(stage.slowdown, 1.0))
             acts = jax.tree_util.tree_map(np.asarray, out)
         return times
 

@@ -3,8 +3,10 @@ from .hooks_collection import (
     CheckpointHook,
     DistributedTimerHelperHook,
     EvalHook,
+    HeartbeatHook,
     MetricsHook,
     NanGuardHook,
+    SelfHealHook,
     StopHook,
     WatchdogHook,
 )
@@ -16,8 +18,10 @@ __all__ = [
     "CheckpointHook",
     "DistributedTimerHelperHook",
     "EvalHook",
+    "HeartbeatHook",
     "MetricsHook",
     "NanGuardHook",
+    "SelfHealHook",
     "StopHook",
     "WatchdogHook",
 ]

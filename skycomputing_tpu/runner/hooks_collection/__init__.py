@@ -2,6 +2,7 @@ from .checkpoint_hook import CheckpointHook
 from .eval_hook import EvalHook
 from .heartbeat_hook import HeartbeatHook
 from .metrics_hook import MetricsHook
+from .selfheal_hook import SelfHealHook
 from .stop_hook import StopHook
 from .timer_hook import DistributedTimerHelperHook
 from .watchdog_hook import NanGuardHook, WatchdogHook
@@ -12,6 +13,7 @@ __all__ = [
     "HeartbeatHook",
     "MetricsHook",
     "NanGuardHook",
+    "SelfHealHook",
     "StopHook",
     "DistributedTimerHelperHook",
     "WatchdogHook",

@@ -148,9 +148,13 @@ class CheckpointHook(Hook):
                 # a restored run replays epoch 1's dropout masks
                 "rng": runner.snapshot_rng(),
             }
+            from ...utils.fileio import atomic_write
+
             ts_path = self._training_state_path(path)
-            with open(ts_path, "wb") as fh:
-                fh.write(serialization.msgpack_serialize(state))
+            # same atomic-publish pattern as the params file: a crash
+            # mid-write must not leave a torn sidecar next to a good
+            # checkpoint (before_run would then fail the whole resume)
+            atomic_write(ts_path, serialization.msgpack_serialize(state))
             runner.logger.info(f"saved training state to {ts_path}")
 
 

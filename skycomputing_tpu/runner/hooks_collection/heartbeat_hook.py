@@ -46,6 +46,17 @@ class HeartbeatHook(Hook):
     def after_iter(self, runner):
         if not self.every_n_iters(runner, self._interval):
             return
+        if getattr(runner, "fault_drop_beat", False):
+            # fault-injection harness (dynamics/faults.py): this process
+            # "misses" its beat window, as a wedged peer would.  Reset
+            # the flag so the harness can tell a consumed drop from one
+            # armed at an iteration where no beat was scheduled.
+            runner.fault_drop_beat = False
+            runner.logger.info(
+                f"HeartbeatHook: beat at iter {runner.iter} dropped by "
+                f"fault injection"
+            )
+            return
         if self._heartbeat.beat():
             return
         runner.logger.info(

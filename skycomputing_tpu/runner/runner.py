@@ -53,6 +53,9 @@ class Runner:
         self._timer = DistributedTimer(**(timer_cfg or {}))
         self.phase_timer = PhaseTimer()
         self.data_loader = None
+        # the in-flight (data, labels) pair, stashed for hooks that need a
+        # representative batch (SelfHealHook probes stage times with it)
+        self.current_batch = None
 
         if loss_cfg is not None:
             # the model already owns a loss; loss_cfg overrides it (and
@@ -163,6 +166,7 @@ class Runner:
                 self._logger.info(
                     f"epoch: {self._epoch}, iter: {self._iter}"
                 )
+                self.current_batch = (data, labels)
                 self._call_hook("before_train_iter")
 
                 self._rng, step_rng = jax.random.split(self._rng)

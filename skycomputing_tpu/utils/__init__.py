@@ -1,4 +1,6 @@
+from .fileio import atomic_write
 from .logger import Logger
+from .retry import RetryError, backoff_delays, retry_call
 from .timer import DistributedTimer, PhaseTimer, get_time
 from .tree import (
     abstract_bytes,
@@ -25,4 +27,8 @@ __all__ = [
     "tree_device_put",
     "tree_to_host",
     "generate_worker_name",
+    "retry_call",
+    "backoff_delays",
+    "RetryError",
+    "atomic_write",
 ]

@@ -1,0 +1,31 @@
+"""Durable atomic file publication.
+
+One implementation of the write-tmp → flush → fsync → ``os.replace``
+pattern, shared by every robustness-critical writer (checkpoints, the
+training-state sidecar, rendezvous ``world.json``/``realloc.json``).  A
+crash — or a ``kill -9`` — at ANY point before the replace leaves the
+previous file intact as the newest complete version; the fsync ensures
+the rename can't outlive its data on power loss.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Union
+
+
+def atomic_write(path: str, data: Union[bytes, str],
+                 tmp_suffix: str = ".tmp") -> None:
+    """Write ``data`` to ``path`` via a same-directory temp file and an
+    atomic rename.  ``tmp_suffix`` disambiguates concurrent writers
+    (e.g. per-node suffixes on a shared rendezvous dir)."""
+    tmp = path + tmp_suffix
+    mode = "wb" if isinstance(data, bytes) else "w"
+    with open(tmp, mode) as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+__all__ = ["atomic_write"]

@@ -562,3 +562,94 @@ def test_calibrate_costs_by_type_rejects_mismatches():
         alloc.calibrate_costs_by_type([4, 4], [1.0])
     with pytest.raises(ValueError):
         alloc.calibrate_costs_by_type([4, 3], [1.0, 2.0])
+
+
+# ------------------------------------------------- device-speed calibration
+def test_stage_divergence_flags_the_degraded_node():
+    """Uniform world, one stage measured 3x its prediction: the divergence
+    map must read ~1.0 everywhere except ~3.0 on the straggler."""
+    alloc, wm = _make_allocator(
+        [1.0] * 3, [1000.0] * 3, [1.0] * 12, [0.1] * 12, n_layers=12
+    )
+    alloc.even_allocate()
+    # stages hold 4 layers each; worker at pipeline order 1 (stim 0) slow
+    div = alloc.stage_divergence([12.0, 4.0, 4.0])
+    assert div[0] == pytest.approx(3.0)
+    assert div[1] == pytest.approx(1.0)
+    assert div[2] == pytest.approx(1.0)
+
+
+def test_calibrate_device_speeds_routes_layers_off_straggler():
+    """Attributing the measured gap to the DEVICE must shrink the slow
+    node's slice on the re-solve — the exact behavior layer attribution
+    (calibrate_costs) cannot produce, since rescaled layers stay
+    expensive wherever they move."""
+    alloc, wm = _make_allocator(
+        [1.0] * 3, [1000.0] * 3, [1.0] * 12, [0.1] * 12, n_layers=12
+    )
+    alloc.even_allocate()
+    measured = [12.0, 4.0, 4.0]  # node-0's stage is 3x slower
+
+    alloc.refine_allocation(measured, damping=1.0, attribute="devices")
+    slow = [w for w in wm.worker_pool if w.name == "node-0"][0]
+    fast = [len(w.model_config) for w in wm.worker_pool
+            if w.name != "node-0"]
+    assert len(slow.model_config) < min(fast)
+    total = []
+    for w in sorted(wm.worker_pool, key=lambda w: w.rank):
+        total.extend(w.model_config)
+    assert total == alloc._model_cfg
+
+    # the calibration is convergent: once the override matches reality,
+    # a consistent re-measurement reads ~1.0 divergence everywhere
+    consistent = [
+        3.0 * len(slow.model_config) if w.name == "node-0"
+        else float(len(w.model_config))
+        for w in sorted(
+            (w for w in wm.worker_pool if w.model_config),
+            key=lambda w: w.order,
+        )
+    ]
+    div = alloc.stage_divergence(consistent)
+    assert all(abs(v - 1.0) < 1e-6 for v in div.values())
+
+
+def test_refine_allocation_rejects_unknown_attribute():
+    import pytest
+
+    alloc, wm = _make_allocator(
+        [1.0, 2.0], [1000.0] * 2, [1.0] * 8, [0.1] * 8, n_layers=8
+    )
+    alloc.even_allocate()
+    with pytest.raises(ValueError, match="unknown attribute"):
+        alloc.refine_allocation([4.0, 4.0], attribute="vibes")
+
+
+def test_apply_device_scales_accepts_json_string_keys():
+    """The rendezvous payload round-trips through JSON (str keys); the
+    seeded override must land on the right workers by stim_index."""
+    alloc, wm = _make_allocator(
+        [1.0] * 3, [1000.0] * 3, [1.0] * 12, [0.1] * 12, n_layers=12
+    )
+    alloc.even_allocate()
+    alloc.apply_device_scales({"1": 4.0})
+    alloc.optimal_allocate()
+    slow = [w for w in wm.worker_pool if w.stim_index == 1][0]
+    fast = [len(w.model_config) for w in wm.worker_pool if w.stim_index != 1]
+    assert len(slow.model_config) < min(fast)
+
+
+def test_remove_running_worker_raises_real_error():
+    """Removing a running worker must be a RuntimeError, not an assert —
+    under ``python -O`` asserts vanish and the removal would be silent."""
+    import pytest
+
+    wm = make_worker_manager(2)
+    worker = wm.worker_pool[0]
+    worker.is_running = True
+    with pytest.raises(RuntimeError, match="still running"):
+        wm.remove_worker_by_id(worker.id)
+    assert wm.size == 2  # nothing was removed
+    worker.is_running = False
+    wm.remove_worker_by_id(worker.id)
+    assert wm.size == 1

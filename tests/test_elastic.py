@@ -77,3 +77,57 @@ def test_profiling_compiled_cost():
                                                           np.float32))
     assert cost["flops"] > 0
     assert "argument_bytes" in cost
+
+
+# ----------------------------------------------------------- file rendezvous
+def test_alive_nodes_skips_stray_files(tmp_path):
+    """A junk '*.alive' file in the shared rendezvous dir must be skipped
+    with a log, not crash every supervisor's membership scan."""
+    from skycomputing_tpu.parallel.elastic import FileRendezvous
+
+    rdv = FileRendezvous(str(tmp_path), node_id=0)
+    rdv.refresh_beacon()
+    ndir = tmp_path / "nodes"
+    (ndir / "editor-backup.alive").write_text("junk")
+    (ndir / ".alive").write_text("junk")
+    (ndir / "7.alive").write_text("beacon")
+    assert rdv.alive_nodes() == [0, 7]
+
+
+def test_realloc_payload_stage_and_consume(tmp_path):
+    """stage_payload -> the next coordinator's form_world embeds it as
+    spec['allocation'] and consumes the staged file."""
+    from skycomputing_tpu.parallel.elastic import FileRendezvous
+
+    rdv = FileRendezvous(str(tmp_path), node_id=0, settle_s=0.0,
+                         timeout_s=10.0)
+    rdv.stage_payload({"device_scale": {"2": 3.0}, "iter": 17})
+    spec = rdv.form_world(1)
+    assert spec["allocation"]["device_scale"]["2"] == 3.0
+    assert spec["allocation"]["iter"] == 17
+    assert not (tmp_path / "realloc.json").exists()  # consumed
+
+    # next generation has no staged payload -> no allocation key
+    spec2 = rdv.form_world(2)
+    assert "allocation" not in spec2
+
+    # a crash re-form re-embeds the coordinator's last known allocation
+    # so restarted supervisors and survivors stay on one model
+    spec3 = rdv.form_world(3, fallback_allocation=spec["allocation"])
+    assert spec3["allocation"]["device_scale"]["2"] == 3.0
+
+    # planned-reform markers persist (no consumption race)
+    assert not rdv.planned_marked(4)
+    rdv.mark_planned(4)
+    assert rdv.planned_marked(4)
+
+
+def test_unreadable_payload_is_discarded(tmp_path):
+    from skycomputing_tpu.parallel.elastic import FileRendezvous
+
+    rdv = FileRendezvous(str(tmp_path), node_id=0, settle_s=0.0,
+                         timeout_s=10.0)
+    (tmp_path / "realloc.json").write_text("{not json")
+    spec = rdv.form_world(1)
+    assert "allocation" not in spec
+    assert not (tmp_path / "realloc.json").exists()

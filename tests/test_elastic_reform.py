@@ -171,3 +171,126 @@ def test_node_loss_reforms_and_resumes(tmp_path):
     resumed = [l for i, (g, l) in by_iter.items() if g == 1]
     assert min(resumed) < min(crash_gen_losses)
     assert max(resumed) < min(crash_gen_losses)
+
+
+# --------------------------------------------------------- planned re-form
+_REALLOC_TRAINER = textwrap.dedent(
+    """
+    import json, os, sys
+    import numpy as np
+
+    from skycomputing_tpu.parallel.elastic import REALLOC_RC, FileRendezvous
+
+    work = sys.argv[1]
+    gen = int(os.environ["SKYTPU_GENERATION"])
+    rank = int(os.environ["SKYTPU_PROCESS_ID"])
+    rdv_dir = os.environ["SKYTPU_RENDEZVOUS"]  # exported by the supervisor
+
+    TOTAL_ITERS = 8
+    ckpt = os.path.join(work, "ckpt.npz")
+    if os.path.exists(ckpt):
+        blob = np.load(ckpt)
+        W, start = blob["W"], int(blob["it"])
+    else:
+        W, start = np.zeros((4,), np.float32), 0
+
+    if gen >= 1:
+        # the re-formed world must carry the staged measurement
+        alloc = json.loads(os.environ["SKYTPU_ALLOCATION"])
+        with open(os.path.join(work, "carried_allocation.json"), "w") as fh:
+            json.dump(dict(alloc, resumed_at=start, gen=gen), fh)
+
+    for it in range(start, TOTAL_ITERS):
+        W = W + 1.0  # a 'step' whose effect the resume must not repeat
+        with open(os.path.join(work, "iters.log"), "a") as fh:
+            fh.write(f"{gen} {it} {float(W[0]):.1f}\\n")
+        tmp = os.path.join(work, "ckpt_tmp")
+        np.savez(tmp, W=W, it=it + 1)
+        os.replace(tmp + ".npz", ckpt)
+        if gen == 0 and it == 3:
+            # self-heal exit: snapshot is on disk, stage the measured
+            # device scales, ask the supervisor for a planned re-form
+            FileRendezvous(rdv_dir, rank).stage_payload(
+                {"device_scale": {"2": 3.0}, "iter": it}
+            )
+            sys.exit(REALLOC_RC)
+    print(f"TRAINER_DONE gen={gen}", flush=True)
+    """
+)
+
+_REALLOC_SUPERVISOR = textwrap.dedent(
+    """
+    import json, os, sys
+    from skycomputing_tpu.parallel.elastic import ElasticSupervisor
+
+    node_id = int(sys.argv[1]); rdv = sys.argv[2]
+    trainer = sys.argv[3]; work = sys.argv[4]
+
+    sup = ElasticSupervisor(
+        node_id, rdv,
+        trainer_cmd=lambda spec, rank: [sys.executable, trainer, work],
+        expect=1,
+        max_reforms=0,   # NO crash budget: a planned re-form must not spend it
+        max_reallocs=2,
+        stale_s=6.0, settle_s=0.5, timeout_s=60.0,
+    )
+    rc = sup.run()
+    print("GENERATIONS " + json.dumps(
+        [s["members"] for s in sup.generations]), flush=True)
+    sys.exit(rc)
+    """
+)
+
+
+def test_realloc_rc_reforms_and_resumes_at_saved_iter(tmp_path):
+    """A trainer exiting REALLOC_RC (the SelfHealHook's planned re-form)
+    is relaunched in a new generation that resumes at the saved iter and
+    sees the staged allocation through world.json — and with
+    ``max_reforms=0`` the planned exit provably does not spend the
+    crash-recovery budget."""
+    import json as json_mod
+
+    work = tmp_path / "work"
+    rdv = tmp_path / "rdv"
+    work.mkdir()
+    trainer = tmp_path / "trainer.py"
+    supervisor = tmp_path / "supervisor.py"
+    trainer.write_text(_REALLOC_TRAINER)
+    supervisor.write_text(_REALLOC_SUPERVISOR)
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    proc = subprocess.Popen(
+        [sys.executable, str(supervisor), "0", str(rdv), str(trainer),
+         str(work)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0, out[-3000:]
+
+    # two generations, same single-node membership
+    gens = json_mod.loads(out.split("GENERATIONS ", 1)[1].splitlines()[0])
+    assert gens == [[0], [0]], gens
+
+    # iteration log: continuous across the planned re-form, no replay
+    rows = [ln.split() for ln in (work / "iters.log").read_text().splitlines()]
+    assert [(int(g), int(it)) for g, it, _ in rows] == (
+        [(0, i) for i in range(4)] + [(1, i) for i in range(4, 8)]
+    )
+    # W incremented exactly once per iter across the boundary
+    assert [float(w) for _, _, w in rows] == [float(i + 1) for i in range(8)]
+
+    # the staged measurement rode through world.json into the relaunch
+    carried = json_mod.loads(
+        (work / "carried_allocation.json").read_text()
+    )
+    assert carried["device_scale"]["2"] == 3.0
+    assert carried["resumed_at"] == 4 and carried["gen"] == 1
