@@ -76,7 +76,10 @@ moves), SKYTPU_BENCH_REFINE (0 — the affine first solve is the
 fixed point; deadline-gated when enabled), SKYTPU_BENCH_EVEN_BRACKET (1),
 SKYTPU_BENCH_CALIBRATION (types | affine | scale | 0),
 SKYTPU_BENCH_SEQUENTIAL=1 to score the reference's non-microbatched
-schedule (sum of stage times) instead.
+schedule (sum of stage times) instead.  SKYTPU_COMPILE_CACHE=0 disables
+the persistent XLA compile cache (any other value overrides its
+directory); SKYTPU_HOTPATH=0 restores the legacy per-microbatch dispatch
+path of the pipeline engine (A/B for tools/bench_step_overhead.py).
 """
 
 from __future__ import annotations
@@ -124,6 +127,20 @@ _RESULT = {
     "partial": "startup: no measurement completed yet",
 }
 _EMITTED = False
+
+# Certification phases the deadline gates forced us to skip or truncate
+# ("polish", "final_remeasure", "refine", "even_bracket", "ffn1").  Always
+# present in the JSON record — an empty list is the positive statement
+# that every enabled phase ran to completion, so a reader can tell
+# "polish converged at 0 moves" from "polish never got budget" (the r05
+# record conflated exactly those two).
+_PHASES_SKIPPED: list = []
+_RESULT["phases_skipped"] = _PHASES_SKIPPED
+
+
+def _skip_phase(name: str) -> None:
+    if name not in _PHASES_SKIPPED:
+        _PHASES_SKIPPED.append(name)
 
 
 def _emit() -> None:
@@ -286,6 +303,17 @@ _probe_backend_or_fallback()
 import jax
 import numpy as np
 import optax
+
+from skycomputing_tpu.utils import enable_persistent_compilation_cache
+
+# Persistent XLA compile cache (opt out: SKYTPU_COMPILE_CACHE=0; set a
+# path to force a directory): repeated bench/ladder runs on a live
+# accelerator stop re-paying the stage-program compile bill — the r04
+# wall-clock blowup was ~50 min of recompiles a prior run had already
+# done.  On the CPU fallback this is a no-op by default (XLA:CPU
+# executable serialization is unsafe in the pinned jaxlib — see
+# utils/compile_cache.py).  The active dir ships in the JSON record.
+_COMPILE_CACHE_DIR = enable_persistent_compilation_cache()
 
 
 def _emit_mfu_artifact(note) -> None:
@@ -714,6 +742,7 @@ def main() -> int:
             if _time_left() < need:
                 note(f"refine stopped before iteration {it}: "
                      f"{_time_left():.0f}s left < {need:.0f}s needed")
+                _skip_phase("refine")
                 break
             # measured raw per-stage seconds calibrate the per-layer costs
             # (slice-level fusion/cache effects the per-unit profile cannot
@@ -796,6 +825,7 @@ def main() -> int:
                 if _time_left() < need:
                     note(f"polish stopped before move {it}: "
                          f"{_time_left():.0f}s left < {need:.0f}s needed")
+                    _skip_phase("polish")
                     break
                 workers = [
                     w for w in sorted(wm.worker_pool, key=lambda w: w.order)
@@ -929,11 +959,13 @@ def main() -> int:
             # bias that reporting best-of would reintroduce
             note("final re-measurement skipped: insufficient budget; "
                  "reporting the last (prediction-driven) polish step")
+            _skip_phase("final_remeasure")
             step_times[alloc_type] = cur_step
         else:
             if ran_refines > 0:
                 note("final re-measurement skipped: insufficient budget; "
                      "reporting the best loop score")
+                _skip_phase("final_remeasure")
                 restore_allocation(best_snap)
             step_times[alloc_type] = best_step
         solver_gap = best_gap
@@ -946,14 +978,17 @@ def main() -> int:
     # stage program is cache-warm) brackets the optimal epoch; the
     # baseline is their mean, and both values ship in the artifact.
     even_steps = [round(step_times["even"], 4)]
-    if (os.getenv("SKYTPU_BENCH_EVEN_BRACKET", "1") != "0"
-            and _time_left() > 0.5 * even_pass_s + 30):
-        e2, _ = measure_current_allocation(
-            even_wm, "even-recheck", ps, n_repeats=repeats + 2,
-            sanity=False,
-        )
-        even_steps.append(round(e2, 4))
-        step_times["even"] = (step_times["even"] + e2) / 2.0
+    if os.getenv("SKYTPU_BENCH_EVEN_BRACKET", "1") != "0":
+        if _time_left() > 0.5 * even_pass_s + 30:
+            e2, _ = measure_current_allocation(
+                even_wm, "even-recheck", ps, n_repeats=repeats + 2,
+                sanity=False,
+            )
+            even_steps.append(round(e2, 4))
+            step_times["even"] = (step_times["even"] + e2) / 2.0
+        else:
+            note("even drift bracket skipped: insufficient budget")
+            _skip_phase("even_bracket")
     speedup_pct = (
         (step_times["even"] - step_times["optimal"]) / step_times["even"] * 100
     )
@@ -990,6 +1025,9 @@ def main() -> int:
              f"(gap {out1['solver_result'].optimality_gap:.4f})")
     elif ffn_shards != 1:
         note("ffn/1 side number skipped (budget or env)")
+        if (os.getenv("SKYTPU_BENCH_EMIT_FFN1", "1") != "0"
+                and _time_left() <= profile_s * 1.3 + 45):
+            _skip_phase("ffn1")
     _RESULT.update(
         value=round(speedup_pct, 2),
         vs_baseline=round(speedup_pct / 55.0, 4),
@@ -1010,6 +1048,7 @@ def main() -> int:
         # model on the timed ffn/1 profile — apples-to-apples with
         # the reference's 1/3-encoder allocation units
         value_ffn1_model=value_ffn1,
+        compile_cache=_COMPILE_CACHE_DIR,
         partial=None,
     )
     # emit FIRST: the headline line must not be hostage to the MFU side

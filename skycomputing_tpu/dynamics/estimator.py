@@ -24,6 +24,13 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _cost_analysis(compiled) -> dict:
+    """Version-normalized ``compiled.cost_analysis()`` (shared shim)."""
+    from ..utils.profiling import normalize_cost_analysis
+
+    return normalize_cost_analysis(compiled.cost_analysis())
+
+
 def _as_tuple(data) -> Tuple:
     return data if isinstance(data, tuple) else (data,)
 
@@ -111,7 +118,7 @@ class Estimator:
         out_aval = jax.eval_shape(apply_fn, params_aval, *avals)
 
         compiled = jax.jit(apply_fn).lower(params_aval, *avals).compile()
-        flops = float(compiled.cost_analysis().get("flops", 0.0))
+        flops = float(_cost_analysis(compiled).get("flops", 0.0))
 
         mb = 1024.0**2
         # Reference formula (estimator.py:85-152): inputs + 2x outputs (grads)
@@ -161,7 +168,7 @@ class Estimator:
     def measure_flops(fn: Callable, *args) -> float:
         """XLA-reported FLOPs of an arbitrary jittable function."""
         compiled = jax.jit(fn).lower(*args).compile()
-        return float(compiled.cost_analysis().get("flops", 0.0))
+        return float(_cost_analysis(compiled).get("flops", 0.0))
 
     @staticmethod
     def benchmark_train_time(

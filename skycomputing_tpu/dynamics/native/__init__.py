@@ -96,6 +96,23 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int),
             ctypes.POINTER(ctypes.c_double),
         ]
+        lib.skytpu_solve_classes.restype = ctypes.c_int
+        lib.skytpu_solve_classes.argtypes = [
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_double,
+            ctypes.c_int,
+            ctypes.c_longlong,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_double),
+        ]
         _lib = lib
         return _lib
 
@@ -214,4 +231,63 @@ def solve_large_native(
     return order, slices, float(out_bottleneck.value)
 
 
-__all__ = ["solve_minmax_native", "solve_large_native", "load"]
+def solve_classes_native(
+    layer_cost,
+    layer_mem,
+    counts,
+    class_dt,
+    class_mem,
+    tolerance: float = 1e-9,
+    max_iters: int = 60,
+    max_states: int = 8_000_000,
+) -> Optional[Tuple[List[int], List[Tuple[int, int]], float]]:
+    """Exact count-vector-DP solve over device CLASSES (few distinct
+    slowdowns).  Returns (slice classes in pipeline order, slices,
+    bottleneck); None when the library is unavailable or the size guard
+    trips; RuntimeError when the class instance is infeasible — the
+    caller decides whether that dooms the real instance (it does not
+    when ``class_mem`` held per-class minima)."""
+    lib = load()
+    if lib is None:
+        return None
+
+    L, K = len(layer_cost), len(class_dt)
+    arr = lambda xs: (ctypes.c_double * len(xs))(*[float(x) for x in xs])
+    iarr = lambda xs: (ctypes.c_int * len(xs))(*[int(x) for x in xs])
+    D = sum(int(c) for c in counts)
+    out_class = (ctypes.c_int * D)()
+    out_starts = (ctypes.c_int * D)()
+    out_ends = (ctypes.c_int * D)()
+    out_bottleneck = ctypes.c_double()
+
+    used = lib.skytpu_solve_classes(
+        L,
+        K,
+        arr(layer_cost),
+        arr(layer_mem),
+        iarr(counts),
+        arr(class_dt),
+        arr(class_mem),
+        float(tolerance),
+        int(max_iters),
+        int(max_states),
+        out_class,
+        out_starts,
+        out_ends,
+        ctypes.byref(out_bottleneck),
+    )
+    if used == -2:
+        return None
+    if used < 0:
+        raise RuntimeError("class instance infeasible")
+    classes = [out_class[i] for i in range(used)]
+    slices = [(out_starts[i], out_ends[i]) for i in range(used)]
+    return classes, slices, float(out_bottleneck.value)
+
+
+__all__ = [
+    "solve_minmax_native",
+    "solve_large_native",
+    "solve_classes_native",
+    "load",
+]

@@ -139,7 +139,7 @@ def _max_window_cost(table: _CoverTable, d: int, T: float,
 
 
 def integral_lower_bound(table: _CoverTable, hi: float,
-                         iters: int = 48, num_separators: int = 3) -> float:
+                         iters: int = 48, num_separators: int = 6) -> float:
     """Largest T such that every T' < T is provably infeasible.
 
     Certificate: pick a layer as a *separator*.  In any feasible
@@ -157,7 +157,11 @@ def integral_lower_bound(table: _CoverTable, hi: float,
     of the ``num_separators`` heaviest layers proves it — a strictly
     tighter (and still valid) bound than the single heaviest-layer choice,
     which matters on calibrated instances where several near-equal heavy
-    layers exist (the refine loop's cost models).
+    layers exist (the refine loop's cost models).  Six separators (was 3)
+    measurably tightens timed-profile instances — their heavy layers come
+    in near-equal families (embeddings, the ffn shards) and the binding
+    separator is not always among the top 3 — at a per-solve cost of
+    milliseconds.
     """
     L = table.num_layers
     total = table.cost_prefix[L]
@@ -410,6 +414,96 @@ def _feasible_greedy(table: _CoverTable, T: float, rng: random.Random,
     return None
 
 
+def _solve_by_classes(
+    layer_cost, layer_mem, device_time, device_mem, tolerance: float,
+    max_classes: int = 8, max_states: int = 8_000_000,
+):
+    """Exact class-collapse solve (see native ``skytpu_solve_classes``).
+
+    Devices sharing a slowdown form a class (exact equality — profiled
+    per-device times collapse only when they really repeat, as the
+    headline instances' integer slowdown draws do).  Two DP solves:
+
+    - per-class MAX member memory: a relaxation of the real instance, so
+      its exact optimum is a certified LOWER bound;
+    - per-class MIN member memory: every produced slice fits every class
+      member, so the partition maps to real devices — a feasible
+      solution (an upper bound).
+
+    With slack memory the two coincide: provably optimal, gap 0 — where
+    the order-anneal left 2-6% certified gaps on noisy timed profiles.
+    Returns ``(solution | None, bound | None)`` with ``solution`` a
+    ``PartitionResult``-shaped tuple ``(device_order, slices,
+    bottleneck)``; both None when the instance doesn't collapse (many
+    distinct speeds) or the native core is unavailable.
+    """
+    groups: dict = {}
+    for d, t in enumerate(device_time):
+        groups.setdefault(float(t), []).append(d)
+    D = len(device_time)
+    if len(groups) > max_classes or len(groups) >= D:
+        return None, None
+    # fast classes first: the DP's early exit takes the lexicographically
+    # smallest covering count-vector, which then spends slow devices last
+    # — among equal-bottleneck optima, prefer the one that drops slow
+    # workers (the allocation the schedule actually wants)
+    class_dt = sorted(groups)
+    members = [groups[t] for t in class_dt]
+    counts = [len(m) for m in members]
+    n_states = 1
+    for c in counts:
+        n_states *= c + 1
+        if n_states > max_states:
+            return None, None
+    from . import native
+
+    mem_max = [max(device_mem[d] for d in m) for m in members]
+    mem_min = [min(device_mem[d] for d in m) for m in members]
+    try:
+        relaxed = native.solve_classes_native(
+            layer_cost, layer_mem, counts, class_dt, mem_max,
+            tolerance=min(tolerance, 1e-9), max_states=max_states,
+        )
+    except RuntimeError:
+        # even with every class at max memory the model does not fit —
+        # the real instance is infeasible too; let the main path raise
+        # its canonical error
+        return None, None
+    if relaxed is None:
+        return None, None
+    bound = relaxed[2]
+    try:
+        tight = native.solve_classes_native(
+            layer_cost, layer_mem, counts, class_dt, mem_min,
+            tolerance=min(tolerance, 1e-9), max_states=max_states,
+        )
+    except RuntimeError:
+        # memory-fragmented inside a class: the conservative solve has no
+        # cover, but the bound above still stands for the anneal path
+        return None, bound
+    if tight is None:
+        return None, bound
+    classes, slices, bottleneck = tight
+    # map class slices onto concrete devices: larger-memory members take
+    # the larger slices (any assignment fits; this ordering keeps slack)
+    mem_prefix = _prefix(layer_mem)
+    by_class: dict = {
+        k: sorted(m, key=lambda d: -device_mem[d])
+        for k, m in enumerate(members)
+    }
+    slice_order = sorted(
+        range(len(slices)),
+        key=lambda i: -(mem_prefix[slices[i][1]] - mem_prefix[slices[i][0]]),
+    )
+    assigned = [None] * len(slices)
+    taken: dict = {k: 0 for k in by_class}
+    for i in slice_order:
+        k = classes[i]
+        assigned[i] = by_class[k][taken[k]]
+        taken[k] += 1
+    return (assigned, [tuple(s) for s in slices], bottleneck), bound
+
+
 def solve_contiguous_minmax(
     layer_cost: Sequence[float],
     layer_mem: Sequence[float],
@@ -452,6 +546,29 @@ def solve_contiguous_minmax(
     total_cost = sum(layer_cost)
     hi = total_cost * max(device_time)  # everything on the slowest device
     lower_bound = integral_lower_bound(table, hi)
+
+    # Class-collapse exact path: few distinct device speeds (the headline
+    # instances' integer slowdown draws) turn the 2^D subset DP into a
+    # count-vector DP — exact in seconds where the anneal certified
+    # 2-6% gaps, and its relaxed solve tightens the bound either way.
+    class_solution = None
+    if use_native and D > native_exact_limit:
+        class_solution, class_bound = _solve_by_classes(
+            layer_cost, layer_mem, device_time, device_mem, tolerance
+        )
+        if class_bound is not None:
+            lower_bound = max(lower_bound, class_bound)
+        if class_solution is not None:
+            c_order, c_slices, c_bottleneck = class_solution
+            if (
+                lower_bound > 0
+                and c_bottleneck / lower_bound - 1.0
+                <= max(gap_target, tolerance)
+            ):
+                return PartitionResult(
+                    c_order, [list(s) for s in c_slices], c_bottleneck,
+                    lower_bound=lower_bound,
+                )
 
     if use_native and D <= native_exact_limit:
         from . import native
@@ -496,8 +613,22 @@ def solve_contiguous_minmax(
             solved = None
         if solved is not None:
             order, slices, bottleneck = solved
+            # The native core's in-anneal polish is single-layer adjacent
+            # shifts only; the Python local search adds 2/4-layer block
+            # moves and bottleneck-device position swaps — complementary
+            # neighborhoods that cost milliseconds and routinely shave
+            # the last fraction of a percent off the certified gap.
+            order, slices = _local_search(
+                table, order, [tuple(s) for s in slices]
+            )
+            achieved = _bottleneck(table, order, slices)
+            if (
+                class_solution is not None
+                and class_solution[2] < achieved
+            ):
+                order, slices, achieved = class_solution
             return PartitionResult(order, [list(s) for s in slices],
-                                   bottleneck, lower_bound=lower_bound)
+                                   achieved, lower_bound=lower_bound)
 
     rng = random.Random(seed)
 
@@ -565,6 +696,9 @@ def solve_contiguous_minmax(
                         achieved = _bottleneck(table, order, slices)
                 evals *= 2
     achieved = _bottleneck(table, order, slices)
+    if class_solution is not None and class_solution[2] < achieved:
+        order, slices, achieved = class_solution
+        slices = list(slices)
     return PartitionResult(order, slices, achieved, lower_bound=lower_bound)
 
 

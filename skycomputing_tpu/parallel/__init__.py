@@ -17,6 +17,9 @@ from .pipeline import (
     PipelineStats,
     StageRuntime,
     clear_program_cache,
+    device_put_elided,
+    hotpath_counters,
+    xla_compile_count,
 )
 
 __all__ = [
@@ -32,6 +35,9 @@ __all__ = [
     "PipelineStats",
     "StageRuntime",
     "clear_program_cache",
+    "device_put_elided",
+    "hotpath_counters",
+    "xla_compile_count",
     "global_mesh",
     "PeerHeartbeat",
     "initialize_from_env",

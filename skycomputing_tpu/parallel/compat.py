@@ -1,0 +1,36 @@
+"""jax version-compatibility shims for the parallel engines.
+
+The engines target the modern ``jax.shard_map`` spelling (with its
+``check_vma`` knob).  Older jax ships the same primitive as
+``jax.experimental.shard_map.shard_map`` with the knob named
+``check_rep`` — semantically the same replication/varying-manual-axes
+check, renamed upstream.  Dispatching here keeps every call site on one
+spelling and the pinned-jaxlib image green.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(body, mesh, in_specs, out_specs, check_vma: bool = False):
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        body,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
+__all__ = ["shard_map"]

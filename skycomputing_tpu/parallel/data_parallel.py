@@ -25,7 +25,12 @@ import optax
 
 from ..dynamics.parameter_server import ParameterServer
 from ..dynamics.worker_manager import WorkerManager
-from .pipeline import PipelineModel, PipelineStats, _split_microbatches
+from .pipeline import (
+    PipelineModel,
+    PipelineStats,
+    _split_microbatches,
+    device_put_elided,
+)
 
 
 class DataParallelPipeline:
@@ -124,7 +129,7 @@ class DataParallelPipeline:
             dev0 = self.replicas[0].stages[k].device
             total = grads_per_replica[0][k]
             for r in range(1, R):
-                moved = jax.device_put(grads_per_replica[r][k], dev0)
+                moved = device_put_elided(grads_per_replica[r][k], dev0)
                 total = self.replicas[0].stages[k]._grad_add(total, moved)
             averaged.append(
                 jax.tree_util.tree_map(lambda x: x / R, total)
@@ -132,10 +137,12 @@ class DataParallelPipeline:
 
         # identical deterministic updates keep replicas in sync without a
         # parameter broadcast
+        # replica 0's puts are same-device no-ops under elision; the other
+        # replicas' averaged-gradient broadcasts are real copies
         for model in self.replicas:
             for k, stage in enumerate(model.stages):
                 stage.apply_gradients(
-                    jax.device_put(averaged[k], stage.device)
+                    device_put_elided(averaged[k], stage.device)
                 )
         jax.block_until_ready(self.replicas[-1].stages[0].params)
         t2 = time.perf_counter()

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import os
 import time
+import warnings
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -44,6 +45,153 @@ import optax
 from ..builder import as_tuple, build_layer_stack
 from ..dynamics.parameter_server import ParameterServer
 from ..dynamics.worker_manager import WorkerManager
+
+
+# --- hot-path switches & counters -------------------------------------------
+# SKYTPU_HOTPATH=0 restores the legacy dispatch path (unconditional
+# device_put, per-microbatch zero cotangents, no donation outside `update`,
+# no input prefetch).  The A/B switch exists so tools/bench_step_overhead.py
+# can measure the host-dispatch split of both paths in one report; the
+# optimized path is the default and the one CI exercises.
+HOTPATH = os.environ.get("SKYTPU_HOTPATH", "1") != "0"
+
+# Backward/accumulate donation is an accelerator optimization: on TPU/GPU
+# it cuts peak HBM (dead stage inputs and grad totals are reused in
+# place), but on the CPU backend buffers are host RAM — there is nothing
+# to save, and the donate bookkeeping measurably SLOWS dispatch (~12% per
+# step on the 8-fake-device microbench).  So donation follows the
+# backend, decided lazily at first program build (jax.default_backend()
+# initializes the platform; import time is too early).  SKYTPU_DONATE=1/0
+# forces it either way — tests use =1 to exercise the donated programs on
+# CPU.  `update` keeps its historical unconditional donation.
+_DONATE = [None]
+
+
+def _donation_enabled() -> bool:
+    if _DONATE[0] is None:
+        forced = os.environ.get("SKYTPU_DONATE")
+        if forced is not None:
+            _DONATE[0] = forced != "0"
+        elif not HOTPATH:
+            _DONATE[0] = False
+        else:
+            try:
+                _DONATE[0] = jax.default_backend() != "cpu"
+            except Exception:  # pragma: no cover - backend init failure
+                _DONATE[0] = False
+    return _DONATE[0]
+
+
+# A donated stage-input tuple includes integer leaves (token ids,
+# attention masks) that have no cotangent and so can never alias into a
+# gradient output; XLA warns about them once per lowered program.  That
+# is expected and not actionable — the float activation buffers DO alias
+# — so silence exactly that message.
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable"
+)
+
+# Process-global transfer accounting for the elided device_put below:
+# "copies" counts puts that actually moved bytes (host->device or
+# cross-device), "elided" counts same-device puts skipped entirely.
+# Module-global like the program cache; snapshot-and-diff per step.
+_TRANSFER_STATS = {"copies": 0, "elided": 0}
+
+# Program-cache accounting (get_stage_programs): a miss means a full
+# _StagePrograms build — layer-stack construction plus, on first execution,
+# XLA compiles for fwd/bwd/update.
+_PROGRAM_CACHE_STATS = {"hits": 0, "misses": 0}
+
+# XLA backend-compile counter, fed by jax.monitoring: every executable the
+# backend actually compiles (a jit cache miss that wasn't served by the
+# persistent compilation cache) emits one duration event.  This is the
+# ground truth for "did this step recompile anything".
+_XLA_COMPILES = [0]
+_COMPILE_LISTENER = [False]
+
+
+def _ensure_compile_listener() -> None:
+    if _COMPILE_LISTENER[0]:
+        return
+    _COMPILE_LISTENER[0] = True
+    try:
+        from jax import monitoring
+
+        def _on_duration(name: str, _secs: float, **_kw) -> None:
+            if name == "/jax/core/compile/backend_compile_duration":
+                _XLA_COMPILES[0] += 1
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:  # pragma: no cover - monitoring API moved/absent
+        pass
+
+
+def xla_compile_count() -> int:
+    """Cumulative XLA backend compiles observed in this process."""
+    _ensure_compile_listener()
+    return _XLA_COMPILES[0]
+
+
+def hotpath_counters() -> Dict[str, int]:
+    """Snapshot of the process-global hot-path counters."""
+    return {
+        "transfer_copies": _TRANSFER_STATS["copies"],
+        "transfers_elided": _TRANSFER_STATS["elided"],
+        "program_cache_hits": _PROGRAM_CACHE_STATS["hits"],
+        "program_cache_misses": _PROGRAM_CACHE_STATS["misses"],
+        "xla_compiles": xla_compile_count(),
+    }
+
+
+def device_put_elided(tree, device):
+    """``jax.device_put`` that skips leaves already living on ``device``.
+
+    The issue loops put every activation/cotangent on its stage's device
+    before dispatch; when producer and consumer share a device (deep
+    pipelines on few chips, replica-0 reductions) the put is pure host
+    overhead — the buffer is already where it must be.  Eliding it also
+    preserves buffer identity, which is what lets backward donation reuse
+    the producer's allocation instead of copying first.
+    """
+    if not HOTPATH:
+        return jax.device_put(tree, device)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    resident = [
+        isinstance(x, jax.Array) and x.device is device for x in leaves
+    ]
+    if all(resident):
+        # the steady-state fast path: no api call, no tree rebuild
+        _TRANSFER_STATS["elided"] += len(leaves)
+        return tree
+    to_move = [x for x, r in zip(leaves, resident) if not r]
+    # ONE batched put for everything that actually moves: per-call fixed
+    # overhead in jax.device_put dwarfs the per-leaf cost, so per-leaf
+    # puts would give back most of what elision saves
+    moved = iter(jax.device_put(to_move, device))
+    _TRANSFER_STATS["copies"] += len(to_move)
+    _TRANSFER_STATS["elided"] += len(leaves) - len(to_move)
+    out = [x if r else next(moved) for x, r in zip(leaves, resident)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# Jitted (base, m, k) -> key derivation.  Folding eagerly costs ~0.6 ms
+# per key in bind/dispatch overhead and a step needs M x S keys; the
+# compiled pair-fold is ~15 us per key with IDENTICAL threefry math, so
+# seeded runs replay exactly the same masks as the eager path.
+_fold2 = jax.jit(
+    lambda rng, m, k: jax.random.fold_in(jax.random.fold_in(rng, m), k)
+)
+_fold1 = jax.jit(jax.random.fold_in)
+
+
+def _step_rngs(rng, M: int, S: int):
+    """The per-(microbatch, stage) dropout-key table for one step."""
+    if HOTPATH:
+        return [[_fold2(rng, m, k) for k in range(S)] for m in range(M)]
+    return [
+        [jax.random.fold_in(jax.random.fold_in(rng, m), k) for k in range(S)]
+        for m in range(M)
+    ]
 
 
 def _split_microbatches(tree, num_microbatches: int, what: str = "microbatches"):
@@ -152,6 +300,26 @@ class _StagePrograms:
         # update's outputs, so XLA can update buffers in place instead of
         # holding two copies of every stage's parameters during the step
         self.update = jax.jit(update, donate_argnums=(0, 1))
+        # Donated twins for the pipeline issue loops only.  Donation
+        # invariants: a stage's stored INPUT tuple is dead the moment its
+        # backward issues (nothing reads it afterwards — remat re-derives
+        # activations from it inside the same program), and a running grad
+        # TOTAL is rebound to accumulate's output, so both buffers may be
+        # reused in place.  The plain bwd/bwd_params_only/grad_add above
+        # stay undonated because measure_stage_times re-executes them with
+        # the SAME input buffers (a donated input is invalid on reuse).
+        # The cotangent argument is never donated: the zero tail of dy is
+        # a per-structure cached buffer shared across microbatches.
+        if _donation_enabled():
+            self.bwd_donated = jax.jit(bwd, donate_argnums=(1,))
+            self.bwd_params_only_donated = jax.jit(
+                bwd_params_only, donate_argnums=(1,)
+            )
+            self.grad_add_donated = jax.jit(grad_add, donate_argnums=(0,))
+        else:
+            self.bwd_donated = self.bwd
+            self.bwd_params_only_donated = self.bwd_params_only
+            self.grad_add_donated = self.grad_add
 
 
 def get_stage_programs(layer_cfgs, optimizer) -> _StagePrograms:
@@ -160,10 +328,16 @@ def get_stage_programs(layer_cfgs, optimizer) -> _StagePrograms:
     key = (
         json.dumps(list(layer_cfgs), sort_keys=True, default=str),
         id(optimizer),
+        # donation is decided per-process but tests force it per-model;
+        # keying on it keeps a forced build from serving cached undonated
+        # programs (or vice versa)
+        _donation_enabled(),
     )
     if key in _PROGRAM_CACHE:
+        _PROGRAM_CACHE_STATS["hits"] += 1
         _PROGRAM_CACHE[key] = _PROGRAM_CACHE.pop(key)  # refresh LRU order
     else:
+        _PROGRAM_CACHE_STATS["misses"] += 1
         while len(_PROGRAM_CACHE) >= PROGRAM_CACHE_MAX_ENTRIES:
             _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
         _PROGRAM_CACHE[key] = _StagePrograms(layer_cfgs, optimizer)
@@ -200,7 +374,10 @@ class StageRuntime:
         self._fwd = programs.fwd
         self._bwd = programs.bwd
         self._bwd_params_only = programs.bwd_params_only
+        self._bwd_donated = programs.bwd_donated
+        self._bwd_params_only_donated = programs.bwd_params_only_donated
         self._grad_add = programs.grad_add
+        self._grad_add_donated = programs.grad_add_donated
         self._update = programs.update
         self._optimizer = optimizer
 
@@ -209,7 +386,14 @@ class StageRuntime:
 
     # --- execution ----------------------------------------------------------
     def forward(self, inputs: Tuple, rng) -> Tuple:
-        inputs = jax.device_put(inputs, self.device)
+        inputs = device_put_elided(inputs, self.device)
+        return self.forward_placed(inputs, rng)
+
+    def forward_placed(self, inputs: Tuple, rng) -> Tuple:
+        """Forward for inputs the caller already committed to this stage's
+        device — the issue loops place inputs themselves (they also store
+        them for backward), so the placement pass here would be a no-op
+        tree traversal per microbatch per stage."""
         out = self._fwd(self.params, inputs, rng)
         if self.slowdown > 1.0:
             start = time.perf_counter()
@@ -219,11 +403,17 @@ class StageRuntime:
         return out
 
     def backward(self, inputs: Tuple, rng, dy: Tuple):
-        dy = jax.device_put(dy, self.device)
+        """Issue the donating backward: ``inputs`` is consumed (the issue
+        loops own the last reference once a microbatch's backward goes
+        out); profiling paths that re-execute with the same buffers must
+        use the undonated ``_bwd``/``_bwd_params_only`` directly."""
+        dy = device_put_elided(dy, self.device)
         if self._differentiable_inputs:
-            grads, dx = self._bwd(self.params, inputs, rng, dy)
+            grads, dx = self._bwd_donated(self.params, inputs, rng, dy)
         else:
-            grads = self._bwd_params_only(self.params, inputs, rng, dy)
+            grads = self._bwd_params_only_donated(
+                self.params, inputs, rng, dy
+            )
             dx = None
         if self.slowdown > 1.0:
             start = time.perf_counter()
@@ -235,7 +425,9 @@ class StageRuntime:
     def accumulate(self, total, grads):
         if total is None:
             return grads
-        return self._grad_add(total, grads)
+        # the old total dies here (the caller rebinds to the sum), so the
+        # donating twin lets XLA accumulate into its buffer in place
+        return self._grad_add_donated(total, grads)
 
     def apply_gradients(self, grads) -> None:
         self.params, self.opt_state = self._update(
@@ -273,6 +465,18 @@ class PipelineStats:
     step_s: float = 0.0
     loss: float = 0.0
     interleaved: bool = False
+    # host-overhead split (the dispatch-profiling record): dispatch_s is
+    # the wall time the host spent ISSUING work (the fwd/bwd/update loops
+    # before their blocking barriers) — the Python-loop tax the devices
+    # cannot overlap away; compute_wait_s is the time spent blocked on
+    # device completion.  transfers/transfers_elided count device_put
+    # leaves moved vs skipped this step; compiles counts XLA backend
+    # compiles triggered this step (0 in steady state).
+    dispatch_s: float = 0.0
+    compute_wait_s: float = 0.0
+    transfers: int = 0
+    transfers_elided: int = 0
+    compiles: int = 0
 
 
 class PipelineModel:
@@ -310,6 +514,13 @@ class PipelineModel:
         self._grad_call_count = 0
 
         self.stages: List[StageRuntime] = []
+        # zero-cotangent tails keyed by last-stage output structure: built
+        # once, shared read-only across microbatches and steps (they are
+        # never donated), instead of M fresh jnp.zeros_like tuples per step
+        self._zero_tail_cache: Dict = {}
+        # dispatch accounting for the most recent compute_gradients call
+        self._last_dispatch_s = 0.0
+        _ensure_compile_listener()
         self._build_stages()
         self._last_device = self.stages[-1].device
         self._compile_loss()
@@ -371,6 +582,28 @@ class PipelineModel:
         self.sync_to_parameter_server()
         self._build_stages()
         self._last_device = self.stages[-1].device
+        self._zero_tail_cache.clear()  # the last stage may have moved
+
+    def _zero_tail(self, acts: Tuple) -> Tuple:
+        """Zero cotangents for ``acts[1:]`` on the last stage's device.
+
+        Non-loss outputs of the final stage (attention masks, pass-through
+        activations) get zero cotangents; the buffers are structure-keyed
+        and reused across microbatches and steps — backward never donates
+        its cotangent argument, so sharing is safe.
+        """
+        if not HOTPATH:
+            return tuple(jnp.zeros_like(x) for x in acts[1:])
+        key = tuple((tuple(x.shape), str(x.dtype)) for x in acts[1:])
+        cached = self._zero_tail_cache.get(key)
+        if cached is None:
+            cached = tuple(
+                jax.device_put(jnp.zeros(x.shape, x.dtype),
+                               self._last_device)
+                for x in acts[1:]
+            )
+            self._zero_tail_cache[key] = cached
+        return cached
 
     # --- reference-API surface ---------------------------------------------
     @property
@@ -397,10 +630,9 @@ class PipelineModel:
             rng = jax.random.fold_in(jax.random.key(0), self._fwd_call_count)
             self._fwd_call_count += 1
         acts = as_tuple(data)
+        fold = _fold1 if HOTPATH else jax.random.fold_in
         for k, stage in enumerate(self.stages):
-            stage_rng = (
-                jax.random.fold_in(rng, k) if rng is not None else None
-            )
+            stage_rng = fold(rng, k) if rng is not None else None
             acts = stage.forward(acts, stage_rng)
         return acts[0]
 
@@ -420,17 +652,27 @@ class PipelineModel:
         last stage, capping per-stage live inputs at the pipeline depth
         instead of M.
         """
+        compiles0 = xla_compile_count()
+        copies0 = _TRANSFER_STATS["copies"]
+        elided0 = _TRANSFER_STATS["elided"]
         grad_totals, losses, (t0, t1, t2) = self.compute_gradients(
             data, labels, rng
         )
         self.apply_gradients(grad_totals)
+        t_upd_issued = time.perf_counter()
         jax.block_until_ready(self.stages[0].params)
         t3 = time.perf_counter()
 
+        dispatch_s = self._last_dispatch_s + (t_upd_issued - t2)
         total_loss = float(sum(jax.device_get(l) for l in losses))
         self.stats = PipelineStats(
             forward_s=t1 - t0, backward_s=t2 - t1, step_s=t3 - t2,
             loss=total_loss, interleaved=self._interleaved,
+            dispatch_s=dispatch_s,
+            compute_wait_s=max((t3 - t0) - dispatch_s, 0.0),
+            transfers=_TRANSFER_STATS["copies"] - copies0,
+            transfers_elided=_TRANSFER_STATS["elided"] - elided0,
+            compiles=xla_compile_count() - compiles0,
         )
         return total_loss
 
@@ -485,23 +727,31 @@ class PipelineModel:
 
         t0 = time.perf_counter()
 
+        # ---- prefetch: issue every host->device input/label transfer up
+        # front so the copies ride the async queues UNDER the first
+        # microbatches' compute instead of serializing inside the loops
+        if HOTPATH:
+            first_device = self.stages[0].device
+            micro_data = [
+                device_put_elided(md, first_device) for md in micro_data
+            ]
+            micro_labels = [
+                device_put_elided(ml, self._last_device)
+                for ml in micro_labels
+            ]
+
         # ---- forward (fill): per microbatch, per stage; keep stage inputs
         stage_inputs: List[List[Tuple]] = [[] for _ in self.stages]
         final_acts_per_mb: List[Tuple] = []
-        rngs = [
-            [
-                jax.random.fold_in(jax.random.fold_in(rng, m), k)
-                for k in range(len(self.stages))
-            ]
-            for m in range(M)
-        ]
+        rngs = _step_rngs(rng, M, len(self.stages))
         for m in range(M):
             acts = micro_data[m]
             for k, stage in enumerate(self.stages):
-                acts = jax.device_put(acts, stage.device)
+                acts = device_put_elided(acts, stage.device)
                 stage_inputs[k].append(acts)
-                acts = stage.forward(acts, rngs[m][k])
+                acts = stage.forward_placed(acts, rngs[m][k])
             final_acts_per_mb.append(acts)
+        dispatch_s = time.perf_counter() - t0
         if block:
             jax.block_until_ready(final_acts_per_mb[-1])
         t1 = time.perf_counter()
@@ -510,20 +760,20 @@ class PipelineModel:
         grad_totals: List[Any] = [None] * len(self.stages)
         losses = []
         for m in reversed(range(M)):
-            labels_m = jax.device_put(micro_labels[m], self._last_device)
+            labels_m = device_put_elided(micro_labels[m], self._last_device)
             final_acts = final_acts_per_mb[m]
             loss_m, dlogits = self._loss_and_dlogits(
                 final_acts[0], labels_m, scale
             )
             losses.append(loss_m)
-            dy: Optional[Tuple] = (dlogits,) + tuple(
-                jnp.zeros_like(x) for x in final_acts[1:]
-            )
+            dy: Optional[Tuple] = (dlogits,) + self._zero_tail(final_acts)
             for k in reversed(range(len(self.stages))):
                 stage = self.stages[k]
                 grads, dx = stage.backward(stage_inputs[k][m], rngs[m][k], dy)
                 grad_totals[k] = stage.accumulate(grad_totals[k], grads)
                 dy = dx
+        dispatch_s += time.perf_counter() - t1
+        self._last_dispatch_s = dispatch_s
         if block:
             jax.block_until_ready(grad_totals[0])
         t2 = time.perf_counter()
@@ -553,13 +803,20 @@ class PipelineModel:
         micro_labels = _split_microbatches(labels, M)
         scale = 1.0 / M
 
-        rngs = [
-            [jax.random.fold_in(jax.random.fold_in(rng, m), k)
-             for k in range(S)]
-            for m in range(M)
-        ]
+        rngs = _step_rngs(rng, M, S)
 
         t0 = time.perf_counter()
+        # prefetch (see the GPipe path): inputs to stage 0, labels to the
+        # last stage, all issued before the first forward
+        if HOTPATH:
+            first_device = self.stages[0].device
+            micro_data = [
+                device_put_elided(md, first_device) for md in micro_data
+            ]
+            micro_labels = [
+                device_put_elided(ml, self._last_device)
+                for ml in micro_labels
+            ]
         # live state
         stage_inputs: List[Dict[int, Tuple]] = [dict() for _ in range(S)]
         stage_outputs: List[Dict[int, Tuple]] = [dict() for _ in range(S)]
@@ -589,20 +846,20 @@ class PipelineModel:
             acts = (
                 micro_data[m] if k == 0 else stage_outputs[k - 1].pop(m)
             )
-            acts = jax.device_put(acts, stage.device)
+            acts = device_put_elided(acts, stage.device)
             stage_inputs[k][m] = acts
-            out = stage.forward(acts, rngs[m][k])
+            out = stage.forward_placed(acts, rngs[m][k])
             if k < S - 1:
                 stage_outputs[k][m] = out
             else:
-                labels_m = jax.device_put(micro_labels[m], self._last_device)
+                labels_m = device_put_elided(
+                    micro_labels[m], self._last_device
+                )
                 loss_m, dlogits = self._loss_and_dlogits(
                     out[0], labels_m, scale
                 )
                 losses.append(loss_m)
-                dys[k][m] = (dlogits,) + tuple(
-                    jnp.zeros_like(x) for x in out[1:]
-                )
+                dys[k][m] = (dlogits,) + self._zero_tail(out)
             fwd_next[k] += 1
 
         def do_bwd(k):
@@ -639,6 +896,7 @@ class PipelineModel:
             if not progressed:  # pragma: no cover - schedule deadlock guard
                 raise RuntimeError("1F1B schedule made no progress")
 
+        self._last_dispatch_s = time.perf_counter() - t0
         if block:
             jax.block_until_ready(grad_totals[0])
         t2 = time.perf_counter()
@@ -709,7 +967,7 @@ class PipelineModel:
         seen: Dict = seed_times if seed_times is not None else {}
         for k, stage in enumerate(self.stages):
             stage_rng = jax.random.fold_in(rng, k)
-            inputs = jax.device_put(acts, stage.device)
+            inputs = device_put_elided(acts, stage.device)
             out = stage._fwd(stage.params, inputs, stage_rng)
             key = (
                 stage.config_key,
@@ -819,4 +1077,12 @@ class PipelineModel:
             cursor += stage.num_layers
 
 
-__all__ = ["PipelineModel", "StageRuntime", "PipelineStats"]
+__all__ = [
+    "PipelineModel",
+    "StageRuntime",
+    "PipelineStats",
+    "device_put_elided",
+    "hotpath_counters",
+    "xla_compile_count",
+    "clear_program_cache",
+]

@@ -44,6 +44,15 @@ class MetricsHook(Hook):
             "step_s": stats.step_s,
             # under 1f1b forward_s holds the fused fwd+bwd time
             "interleaved": stats.interleaved,
+            # host-overhead split: time spent issuing work vs blocked on
+            # devices, device_put copies performed vs elided, and XLA
+            # backend compiles this step (nonzero after step 1 means a
+            # recompile regression — exactly what this record is for)
+            "dispatch_s": stats.dispatch_s,
+            "compute_wait_s": stats.compute_wait_s,
+            "transfers": stats.transfers,
+            "transfers_elided": stats.transfers_elided,
+            "compiles": stats.compiles,
         }
         self._fh.write(json.dumps(record) + "\n")
         self._pending += 1

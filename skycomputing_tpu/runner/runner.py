@@ -19,7 +19,12 @@ import jax
 from ..dynamics import ParameterServer, WorkerManager
 from ..ops import build_loss
 from ..parallel import PipelineModel
-from ..utils import DistributedTimer, Logger, PhaseTimer
+from ..utils import (
+    DistributedTimer,
+    Logger,
+    PhaseTimer,
+    enable_persistent_compilation_cache,
+)
 from .hooks import Hook
 
 
@@ -39,6 +44,11 @@ class Runner:
         self.model = model
         self.parameter_server = parameter_server
         self.worker_manager = worker_manager
+        # persistent XLA compile cache: a relaunched/re-formed trainer (or
+        # a repeated run of the same config) reuses serialized executables
+        # instead of recompiling every stage program.  Opt out with
+        # SKYTPU_COMPILE_CACHE=0; silently a no-op when wiring fails.
+        self.compilation_cache_dir = enable_persistent_compilation_cache()
 
         self._hooks: List[Hook] = []
         self._epoch = 0
@@ -178,18 +188,24 @@ class Runner:
                 self.phase_timer.record("forward", stats.forward_s)
                 self.phase_timer.record("backward", stats.backward_s)
                 self.phase_timer.record("step", stats.step_s)
+                self.phase_timer.record("dispatch", stats.dispatch_s)
+                overhead = (
+                    f" | dispatch: {stats.dispatch_s:.4f} "
+                    f"(copies {stats.transfers}, elided "
+                    f"{stats.transfers_elided}, compiles {stats.compiles})"
+                )
                 if stats.interleaved:
                     self._logger.info(
                         f"loss: {loss:.6f} | fwd+bwd (fused, 1f1b): "
                         f"{stats.forward_s:.4f} | step time: "
-                        f"{stats.step_s:.4f}"
+                        f"{stats.step_s:.4f}{overhead}"
                     )
                 else:
                     self._logger.info(
                         f"loss: {loss:.6f} | forward time: "
                         f"{stats.forward_s:.4f} | backward time: "
                         f"{stats.backward_s:.4f} | step time: "
-                        f"{stats.step_s:.4f}"
+                        f"{stats.step_s:.4f}{overhead}"
                     )
 
                 self._iter += 1

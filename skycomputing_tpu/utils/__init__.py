@@ -1,3 +1,7 @@
+from .compile_cache import (
+    compilation_cache_dir,
+    enable_persistent_compilation_cache,
+)
 from .fileio import atomic_write
 from .logger import Logger
 from .retry import RetryError, backoff_delays, retry_call
@@ -31,4 +35,6 @@ __all__ = [
     "backoff_delays",
     "RetryError",
     "atomic_write",
+    "compilation_cache_dir",
+    "enable_persistent_compilation_cache",
 ]

@@ -33,6 +33,15 @@ import pytest  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Persistent XLA compile cache, should this suite ever run on an
+# accelerator backend.  On the CPU harness this is a deliberate no-op:
+# XLA:CPU executable serialization in the pinned jaxlib corrupts the
+# heap (glibc "corrupted double-linked list" aborts mid-suite), so the
+# helper only engages off-CPU unless a directory is set explicitly.
+from skycomputing_tpu.utils import enable_persistent_compilation_cache  # noqa: E402
+
+enable_persistent_compilation_cache()
+
 
 @pytest.fixture(scope="session")
 def devices():

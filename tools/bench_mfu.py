@@ -113,7 +113,9 @@ def main() -> int:
     lowered = step.lower(params, opt_state, ids, types, mask, labels)
     print("compiling train step...", flush=True)
     compiled = lowered.compile()
-    cost = compiled.cost_analysis()
+    from skycomputing_tpu.utils.profiling import normalize_cost_analysis
+
+    cost = normalize_cost_analysis(compiled.cost_analysis())
     flops = float(cost.get("flops", 0.0))
 
     def run(params, opt_state):
@@ -198,7 +200,10 @@ def main() -> int:
         return jax.value_and_grad(f)(p)
 
     sstep = jax.jit(stage_fwd_bwd)
-    scost = sstep.lower(sparams, hidden).compile().cost_analysis()
+    from skycomputing_tpu.utils.profiling import normalize_cost_analysis
+
+    scost = normalize_cost_analysis(
+        sstep.lower(sparams, hidden).compile().cost_analysis())
     st = timed(sstep, sparams, hidden)
     sflops = float(scost.get("flops", 0.0))
     print(

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Pipeline step-overhead microbench: host dispatch vs device compute.
+
+The MPMD engine's step time has two components the devices cannot see:
+the Python issue loops (dispatch) and the blocking waits (compute).  This
+tool measures the split on a tiny BERT pipeline (fake 8-device CPU mesh,
+tier-1-sized: a couple of minutes end to end) for BOTH schedules, A/B
+against the legacy dispatch path by toggling ``pipeline.HOTPATH`` on the
+SAME model in the SAME process — paired rounds, alternating modes, so
+machine-load drift hits both sides alike (a sequential two-process A/B
+mis-attributed container noise to the mode split).  The one build-time
+difference, backward/accumulate donation, is off on the CPU backend in
+both modes (see ``_donation_enabled``), so the toggle is a complete A/B
+of the runtime hot path: transfer elision + single batched puts, input
+prefetch, jitted rng pair-fold, cached zero cotangents.
+
+Usage::
+
+    python tools/bench_step_overhead.py           # A/B report (default)
+    python tools/bench_step_overhead.py --no-ab   # hot path only
+
+Prints one JSON line (machine-readable) and a human summary.  Counters
+come from ``PipelineStats`` — the same record ``MetricsHook`` ships per
+training iteration — so a regression visible here is visible in
+production telemetry too.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+N_DEVICES = 8
+STEPS = 6    # timed steps per (round, mode, schedule)
+ROUNDS = 4   # alternating paired rounds; report each mode's best round
+
+if os.environ.get("SKYTPU_BENCH_OVERHEAD_REEXEC") != "1":
+    from __graft_entry__ import scrubbed_env
+
+    env = scrubbed_env(N_DEVICES)
+    env["SKYTPU_BENCH_OVERHEAD_REEXEC"] = "1"
+    os.execvpe(sys.executable, [sys.executable] + sys.argv, env)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+
+def _build(schedule):
+    from skycomputing_tpu.dynamics import (
+        Allocator,
+        ParameterServer,
+        WorkerManager,
+    )
+    from skycomputing_tpu.models import bert_config, bert_layer_configs
+    from skycomputing_tpu.ops import cross_entropy_loss
+    from skycomputing_tpu.parallel import PipelineModel
+
+    cfg = bert_config("tiny", dtype="float32", hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)
+    model_cfg = bert_layer_configs(cfg, num_encoder_units=3,
+                                   num_classes=3, deterministic=True)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(5, 1024, size=(16, 32)).astype(np.int32)
+    data = (ids, np.zeros_like(ids), np.ones_like(ids))
+    labels = rng.integers(0, 3, size=(16,)).astype(np.int32)
+    wm = WorkerManager()
+    wm.load_worker_pool_from_config(
+        [dict(name=f"node-{i}", device_config=dict(device_index=i))
+         for i in range(4)]
+    )
+    Allocator(model_cfg, wm, None, None).even_allocate()
+    ps = ParameterServer(model_cfg, example_inputs=data,
+                         rng=jax.random.key(0))
+    model = PipelineModel(
+        wm, ps, optax.sgd(1e-2), cross_entropy_loss,
+        devices=jax.devices(), num_microbatches=8, schedule=schedule,
+    )
+    return model, data, labels
+
+
+def _sample(model, data, labels, base_key: int):
+    """Median step/dispatch over STEPS steps in the CURRENT mode."""
+    walls, dispatches, waits = [], [], []
+    copies = elided = compiles = 0
+    for i in range(STEPS):
+        t0 = time.perf_counter()
+        model.train_step(data, labels, rng=jax.random.key(base_key + i))
+        walls.append(time.perf_counter() - t0)
+        s = model.stats
+        dispatches.append(s.dispatch_s)
+        waits.append(s.compute_wait_s)
+        copies += s.transfers
+        elided += s.transfers_elided
+        compiles += s.compiles
+    return dict(
+        step_wall_s=float(np.median(walls)),
+        dispatch_s=float(np.median(dispatches)),
+        compute_wait_s=float(np.median(waits)),
+        transfers=copies,
+        transfers_elided=elided,
+        compiles=compiles,
+    )
+
+
+def main() -> int:
+    from skycomputing_tpu.parallel import pipeline as pl
+
+    ab = "--no-ab" not in sys.argv
+    modes = [True, False] if ab else [True]
+    report = {}
+    for schedule in ("gpipe", "1f1b"):
+        model, data, labels = _build(schedule)
+        for hp in modes + [True]:  # warm/compile both paths
+            pl.HOTPATH = hp
+            model.train_step(data, labels, rng=jax.random.key(0))
+        rounds = {m: [] for m in modes}
+        for r in range(ROUNDS):
+            for hp in modes:  # paired within each round
+                pl.HOTPATH = hp
+                rounds[hp].append(
+                    _sample(model, data, labels, base_key=10 + r)
+                )
+        pl.HOTPATH = True
+        report[schedule] = {
+            ("hotpath" if m else "legacy"): min(
+                rounds[m], key=lambda s: s["step_wall_s"]
+            )
+            for m in modes
+        }
+    out = {"steps": STEPS, "rounds": ROUNDS, "schedules": report}
+    print(json.dumps(out), flush=True)
+    for schedule, by_mode in report.items():
+        for mode, agg in by_mode.items():
+            frac = (agg["dispatch_s"] / agg["step_wall_s"]
+                    if agg["step_wall_s"] > 0 else 0.0)
+            print(
+                f"# {mode:>7} {schedule:>5}: "
+                f"step {agg['step_wall_s'] * 1e3:8.2f} ms | dispatch "
+                f"{agg['dispatch_s'] * 1e3:7.2f} ms ({frac * 100:5.1f}%) | "
+                f"copies {agg['transfers']:4d} | elided "
+                f"{agg['transfers_elided']:4d} | compiles {agg['compiles']}"
+            )
+        if ab:
+            new, old = by_mode["hotpath"], by_mode["legacy"]
+            print(
+                f"# {schedule}: dispatch "
+                f"{old['dispatch_s'] * 1e3:.2f} -> "
+                f"{new['dispatch_s'] * 1e3:.2f} ms/step "
+                f"({(1 - new['dispatch_s'] / max(old['dispatch_s'], 1e-12)) * 100:+.1f}%"
+                f" less host overhead), step "
+                f"{old['step_wall_s'] * 1e3:.2f} -> "
+                f"{new['step_wall_s'] * 1e3:.2f} ms"
+            )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
