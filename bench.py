@@ -523,6 +523,21 @@ def main() -> int:
             w.extra_config["slowdown"] = 1.0
         loss = None
         try:
+            # pre-flight plan verification (abstract, eval_shape only):
+            # a malformed allocation is rejected HERE with a precise
+            # diagnostic, before the pipeline build pays any compile.
+            # Memory surfaces as warnings — the even baseline ignores
+            # budgets by design and the allocator already enforced them
+            # for the optimal side.
+            from skycomputing_tpu.analysis.plan_check import verify_plan
+
+            plan_report = verify_plan(
+                model_cfg, wm, data, layer_mem=layer_mem, memory="warn"
+            )
+            for issue in plan_report.issues:
+                note(f"{label}: pre-flight {issue.format()}")
+            plan_report.raise_if_failed()
+            note(f"{label}: pre-flight {plan_report.summary()}")
             model = PipelineModel(
                 wm, ps, optimizer, cross_entropy_loss, devices=devices
             )

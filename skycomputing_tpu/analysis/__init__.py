@@ -1,0 +1,46 @@
+"""Static analysis for JAX training code: skylint + the plan verifier.
+
+Two complementary halves, both pushing failures from run time to commit /
+launch time (the way compiler-partitioners like GSPMD turn placement bugs
+into compile errors):
+
+- :mod:`.lint` — **skylint**, an AST linter with repo-specific rule
+  classes for the hazards that cost real wall clock or correctness in
+  this codebase: hidden host-device syncs in hot paths, recompile
+  hazards, PRNG indiscipline, donation misuse, dishonest timing, debug
+  leftovers, and structural violations of the tuple-threading layer
+  protocol.  CLI: ``python -m tools.skylint``.
+- :mod:`.plan_check` — the **pre-flight plan verifier**: given a layer
+  config, an allocation, and device budgets, abstractly verify (via
+  ``jax.eval_shape`` — zero FLOPs) stage-boundary shape/dtype agreement,
+  coverage/contiguity of the layer partition, per-device memory fit, and
+  donation-aliasing validity, plus schema validation of the elastic
+  re-form ``realloc.json`` payload.  Wired into ``Runner`` startup,
+  ``bench.py``, and the ``ElasticSupervisor`` re-form path.
+"""
+
+from .lint import Finding, LintConfig, lint_file, lint_paths, RULES
+from .plan_check import (
+    PlanError,
+    PlanIssue,
+    PlanReport,
+    has_plan,
+    verify_allocation_payload,
+    verify_pipeline,
+    verify_plan,
+)
+
+__all__ = [
+    "Finding",
+    "LintConfig",
+    "lint_file",
+    "lint_paths",
+    "RULES",
+    "PlanError",
+    "PlanIssue",
+    "PlanReport",
+    "has_plan",
+    "verify_allocation_payload",
+    "verify_pipeline",
+    "verify_plan",
+]

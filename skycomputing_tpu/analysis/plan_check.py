@@ -1,0 +1,667 @@
+"""Pre-flight plan verifier: reject a bad allocation before any compile.
+
+The paper's loop — benchmark, solve a layer->device allocation, commit a
+long run to it — makes late failure the expensive failure mode: a stage
+boundary that doesn't type-check, an over-budget slice, or a malformed
+re-form payload surfaces minutes into a launch (after the compile bill)
+or hours in (at the first re-allocation).  Everything checked here is
+checked *abstractly*: shapes thread through ``jax.eval_shape`` — zero
+FLOPs, no parameters materialized — so a full 64-stage verification runs
+in well under a second.
+
+Checks
+------
+- **coverage / contiguity**: the workers' layer slices tile the model
+  config exactly — no gaps, overlaps, or shuffled content;
+- **stage-boundary agreement**: every layer accepts the shapes/dtypes the
+  previous layer produces (per-layer ``eval_shape`` threading, deduped by
+  (config, input-signature) the way the stage-program cache dedups);
+- **memory fit**: per-stage static memory (the estimator's formula:
+  inputs + 2x outputs + ``param_scale`` x params at 4 bytes) against each
+  worker's configured ``mem_limit`` budget;
+- **donation aliasing**: the backward cotangent avals (via an
+  ``eval_shape`` of the stage vjp) match the stage-input float leaves, so
+  ``donate_argnums`` aliasing is valid; integer leaves are reported as
+  non-aliasable (expected — they have no cotangent);
+- **re-form payload schema** (:func:`verify_allocation_payload`): the
+  ``realloc.json`` / ``SKYTPU_ALLOCATION`` payload the elastic supervisor
+  carries between generations.
+
+Wiring: ``Runner`` runs :func:`verify_pipeline` on its first batch before
+the first train step; ``bench.py`` verifies each allocation before
+building its pipeline; ``FileRendezvous.take_payload`` and
+``ElasticSupervisor._launch`` validate the re-form payload before it can
+reach a trainer.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..builder import as_tuple, build_layer
+
+
+# --------------------------------------------------------------------------
+# report model
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PlanIssue:
+    """One verifier diagnostic."""
+
+    code: str       # coverage | shape | memory | donation | payload
+    severity: str   # error | warning | info
+    message: str
+
+    def format(self) -> str:
+        return f"[{self.severity}] plan-check/{self.code}: {self.message}"
+
+
+class PlanError(RuntimeError):
+    """Raised when a plan fails verification; carries every diagnostic."""
+
+    def __init__(self, issues: Sequence[PlanIssue]):
+        self.issues = list(issues)
+        lines = [i.format() for i in self.issues]
+        super().__init__(
+            "allocation plan failed pre-flight verification:\n  "
+            + "\n  ".join(lines)
+        )
+
+
+@dataclass
+class PlanReport:
+    """Outcome of one verification run."""
+
+    issues: List[PlanIssue] = field(default_factory=list)
+    checks: List[str] = field(default_factory=list)
+    elapsed_s: float = 0.0
+    stages: int = 0
+    layers: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    @property
+    def errors(self) -> List[PlanIssue]:
+        return [i for i in self.issues if i.severity == "error"]
+
+    @property
+    def warnings(self) -> List[PlanIssue]:
+        return [i for i in self.issues if i.severity == "warning"]
+
+    def raise_if_failed(self) -> None:
+        if not self.ok:
+            raise PlanError(self.errors + self.warnings)
+
+    def summary(self) -> str:
+        state = "ok" if self.ok else f"{len(self.errors)} error(s)"
+        return (
+            f"plan-check {state}: {self.stages} stages / {self.layers} "
+            f"layers, checks=[{', '.join(self.checks)}], "
+            f"{len(self.warnings)} warning(s), {self.elapsed_s:.3f}s"
+        )
+
+
+# --------------------------------------------------------------------------
+# abstract tracing helpers (all eval_shape — no FLOPs, no params)
+# --------------------------------------------------------------------------
+
+
+def _canon(cfg: Dict) -> str:
+    return json.dumps(cfg, sort_keys=True, default=str)
+
+
+def _avals(inputs) -> Tuple[jax.ShapeDtypeStruct, ...]:
+    out = []
+    for x in as_tuple(inputs):
+        if isinstance(x, jax.ShapeDtypeStruct):
+            out.append(x)
+        else:
+            dtype = getattr(x, "dtype", None)
+            if dtype is None:
+                dtype = np.asarray(x).dtype
+            out.append(jax.ShapeDtypeStruct(np.shape(x), np.dtype(dtype)))
+    return tuple(out)
+
+
+def _sig(avals) -> Tuple:
+    return tuple((tuple(a.shape), str(a.dtype)) for a in avals)
+
+
+def _mb(tree) -> float:
+    total = 0.0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        n = float(np.prod(leaf.shape)) if leaf.shape else 1.0
+        total += n * 4.0
+    return total / 1024.0**2
+
+
+def _layer_module(cfg: Dict):
+    c = dict(cfg)
+    layer_type = c.pop("layer_type")
+    return build_layer(layer_type, **c)
+
+
+def _exc_line(exc: Exception) -> str:
+    """First line of an exception message ('' -> the type alone)."""
+    lines = str(exc).splitlines()
+    return lines[0] if lines else "(no message)"
+
+
+def _trace_layer(cfg: Dict, avals):
+    """(out_avals, (in_mb, out_mb, params_mb), params_aval), abstractly.
+
+    One ``eval_shape`` over ``init_with_output`` yields both the output
+    and the parameter avals — a flax init IS a traced forward, so a
+    separate apply trace would double the cost for nothing.  Memory is
+    returned as raw components so the cached trace stays valid for any
+    ``param_scale`` (the estimator formula is applied at lookup).
+    """
+    module = _layer_module(cfg)
+    base = jax.random.key(0)
+    k_params, k_dropout = jax.random.split(base)
+    out_aval, variables = jax.eval_shape(
+        lambda *a: module.init_with_output(
+            {"params": k_params, "dropout": k_dropout}, *a
+        ),
+        *avals,
+    )
+    params_aval = variables["params"]
+    out_avals = as_tuple(out_aval)
+    mem_parts = (_mb(avals), _mb(out_avals), _mb(params_aval))
+    return out_avals, mem_parts, params_aval
+
+
+def _layer_mem_mb(mem_parts, param_scale: int) -> float:
+    """The estimator formula: inputs + 2x outputs + scale x params."""
+    in_mb, out_mb, params_mb = mem_parts
+    return in_mb + 2.0 * out_mb + float(param_scale) * params_mb
+
+
+def _trace_layer_cotangents(cfg, params_aval, in_avals, out_avals):
+    """dx avals of one layer's backward, via eval_shape of the vjp.
+
+    A stage's input-cotangent signature is fixed by its FIRST layer (the
+    chain rule only threads cotangents through it), so donation-aliasing
+    validity is checked per distinct (layer, input signature) — a handful
+    of vjp traces instead of one per stage.
+    """
+    module = _layer_module(cfg)
+    k_dropout = jax.random.key(1)
+
+    def f(p, x):
+        return as_tuple(
+            module.apply({"params": p}, *x, rngs={"dropout": k_dropout})
+        )
+
+    def bwd(p, x, dy):
+        _, vjp = jax.vjp(f, p, x)
+        _dp, dx = vjp(dy)
+        return dx
+
+    # NB: jnp.issubdtype, not np.issubdtype — bfloat16 is an ml_dtypes
+    # dtype that plain numpy does not classify as inexact
+    dy = tuple(
+        a if jnp.issubdtype(a.dtype, jnp.inexact)
+        else np.zeros(a.shape, jax.dtypes.float0)
+        for a in out_avals
+    )
+    return jax.eval_shape(bwd, params_aval, tuple(in_avals), dy)
+
+
+# trace caches are module-global: avals only (no buffers), keyed by
+# (canonical layer config, input signature).  A bench run verifies two
+# allocations of the same model and a re-formed trainer re-verifies the
+# same structures — re-tracing would repeat identical abstract work.
+_LAYER_TRACE_CACHE: Dict[Tuple, Tuple] = {}
+_COTANGENT_CACHE: Dict[Tuple, Any] = {}
+
+
+# --------------------------------------------------------------------------
+# core verification
+# --------------------------------------------------------------------------
+
+
+def _stage_workers(worker_manager) -> List[Any]:
+    """Rank-sorted workers with a non-empty layer slice (the stages)."""
+    return sorted(
+        (w for w in worker_manager.worker_pool if w.model_config),
+        key=lambda w: w.rank,
+    )
+
+
+def _worker_slice(worker, start: int, end: int) -> Dict:
+    """One engine slice record for a worker: label + bounds + budget.
+
+    The single place that maps ``extra_config['mem_limit']`` to a
+    verifier budget (<=0 / absent means "no budget configured") — both
+    entry points must agree on these semantics.
+    """
+    mem_limit = worker.extra_config.get("mem_limit") if \
+        hasattr(worker, "extra_config") else None
+    return dict(
+        label=f"worker rank {worker.rank}",
+        start=start,
+        end=end,
+        mem_budget_mb=(
+            float(mem_limit)
+            if mem_limit is not None and float(mem_limit) > 0
+            else None
+        ),
+    )
+
+
+def _verify_slices(
+    model_cfg: List[Dict],
+    slices: List[Dict],
+    example_inputs,
+    *,
+    layer_mem: Optional[Sequence[float]] = None,
+    memory: str = "error",
+    check_shapes: bool = True,
+    check_donation: bool = True,
+    param_scale: int = 2,
+) -> PlanReport:
+    """Shared engine.  ``slices``: dicts with keys ``label`` (e.g.
+    'worker rank 3'), ``start``, ``end``, ``mem_budget_mb`` (None = no
+    budget configured)."""
+    t0 = time.perf_counter()
+    report = PlanReport(stages=len(slices), layers=len(model_cfg))
+    issues = report.issues
+
+    # ---- shape threading + per-layer memory, deduped by structure
+    if layer_mem is not None and len(layer_mem) != len(model_cfg):
+        # a profile at the wrong granularity must not crash the verifier
+        # (its whole job is precise rejection): flag it and fall back to
+        # the traced per-layer estimate
+        issues.append(PlanIssue(
+            "memory", "error" if memory == "error" else "warning",
+            f"layer_mem holds {len(layer_mem)} entries for "
+            f"{len(model_cfg)} layers — the memory profile does not "
+            f"match this model config; using traced estimates instead"
+        ))
+        layer_mem = None
+    mem_per_layer: List[Optional[float]] = (
+        [float(m) for m in layer_mem]
+        if layer_mem is not None else [None] * len(model_cfg)
+    )
+    stage_in_avals: List[Tuple] = []
+    stage_out_avals: List[Tuple] = []
+    # the donation check consumes the threaded stage avals, so threading
+    # runs whenever EITHER abstract check is requested (or memory needs
+    # the per-layer estimate); a plan that fails to thread is broken
+    # regardless of which check the caller named, so shape errors are
+    # always reported
+    if check_shapes or check_donation or \
+            (memory != "skip" and layer_mem is None):
+        if check_shapes:
+            report.checks.append("shapes")
+        cache = _LAYER_TRACE_CACHE
+        avals = _avals(example_inputs)
+        aborted = False
+        for s in slices:
+            stage_in_avals.append(avals)
+            for i in range(s["start"], s["end"]):
+                cfg = model_cfg[i]
+                key = (_canon(cfg), _sig(avals))
+                try:
+                    if key in cache:
+                        out_avals, mem_parts, params_aval = cache[key]
+                    else:
+                        out_avals, mem_parts, params_aval = _trace_layer(
+                            cfg, avals
+                        )
+                        cache[key] = (out_avals, mem_parts, params_aval)
+                except Exception as exc:  # trace-time rejection
+                    shapes = [
+                        f"{tuple(a.shape)}:{a.dtype}" for a in avals
+                    ]
+                    issues.append(PlanIssue(
+                        "shape", "error",
+                        f"layer {i} "
+                        f"({cfg.get('layer_type', '?')}, {s['label']}) "
+                        f"rejects the boundary signature "
+                        f"[{', '.join(shapes)}] produced by layer "
+                        f"{i - 1 if i else 'input'}: "
+                        f"{type(exc).__name__}: {_exc_line(exc)}"
+                    ))
+                    aborted = True
+                    break
+                if mem_per_layer[i] is None:
+                    mem_per_layer[i] = _layer_mem_mb(mem_parts,
+                                                     param_scale)
+                avals = out_avals
+            stage_out_avals.append(avals)
+            if aborted:
+                break
+
+    # ---- memory fit
+    if memory != "skip" and not any(m is None for m in mem_per_layer):
+        report.checks.append("memory")
+        for s in slices:
+            budget = s.get("mem_budget_mb")
+            need = float(sum(mem_per_layer[s["start"]:s["end"]]))
+            if budget is None:
+                continue
+            if need > float(budget):
+                issues.append(PlanIssue(
+                    "memory", "error" if memory == "error" else "warning",
+                    f"{s['label']} (layers {s['start']}..{s['end'] - 1}) "
+                    f"needs {need:.6g} MB but its budget is "
+                    f"{float(budget):.6g} MB "
+                    f"({need / float(budget):.2f}x over)"
+                ))
+
+    # ---- donation aliasing (needs the threaded avals)
+    if check_donation and len(stage_out_avals) == len(slices) and \
+            not report.errors:
+        report.checks.append("donation")
+        dcache = _COTANGENT_CACHE
+        for k, s in enumerate(slices):
+            if k == 0:
+                # first stage never produces input cotangents
+                # (differentiable_inputs=False) — its donated inputs have
+                # no alias target, which the engine expects and XLA warns
+                # about once; nothing to verify
+                continue
+            first_cfg = model_cfg[s["start"]]
+            in_avals = stage_in_avals[k]
+            key = (_canon(first_cfg), _sig(in_avals))
+            try:
+                if key in dcache:
+                    dx = dcache[key]
+                else:
+                    # the threading loop above (which gates this block)
+                    # already traced every stage's first layer under
+                    # exactly this key
+                    first_out, _parts, first_params = \
+                        _LAYER_TRACE_CACHE[key]
+                    dx = _trace_layer_cotangents(
+                        first_cfg, first_params, in_avals, first_out,
+                    )
+                    dcache[key] = dx
+            except Exception as exc:
+                issues.append(PlanIssue(
+                    "donation", "error",
+                    f"stage {k} ({s['label']}): backward does not "
+                    f"abstractly evaluate: {type(exc).__name__}: "
+                    f"{_exc_line(exc)}"
+                ))
+                continue
+            dx_leaves = list(dx)
+            for idx, (a, d) in enumerate(zip(in_avals, dx_leaves)):
+                if not jnp.issubdtype(a.dtype, jnp.inexact):
+                    continue  # integer leaf: no cotangent, not aliasable
+                if tuple(d.shape) != tuple(a.shape) or \
+                        np.dtype(d.dtype) != np.dtype(a.dtype):
+                    issues.append(PlanIssue(
+                        "donation", "error",
+                        f"stage {k} ({s['label']}) input leaf {idx}: "
+                        f"donated buffer is {tuple(a.shape)}:{a.dtype} "
+                        f"but its cotangent is "
+                        f"{tuple(d.shape)}:{d.dtype} — donation cannot "
+                        f"alias (weak-type/dtype drift in the layer's "
+                        f"vjp)"
+                    ))
+
+    report.elapsed_s = time.perf_counter() - t0
+    return report
+
+
+def verify_plan(
+    model_cfg: List[Dict],
+    worker_manager,
+    example_inputs,
+    *,
+    layer_mem: Optional[Sequence[float]] = None,
+    memory: str = "error",
+    check_shapes: bool = True,
+    check_donation: bool = True,
+    param_scale: int = 2,
+) -> PlanReport:
+    """Verify an allocation written onto a ``WorkerManager`` against the
+    intended ``model_cfg`` (coverage + contiguity + the abstract checks).
+
+    ``memory``: 'error' | 'warn' | 'skip' — over-budget slices either
+    fail the plan, surface as warnings (the bench's even baseline
+    deliberately ignores budgets), or are not checked.
+    """
+    workers = _stage_workers(worker_manager)
+    slices: List[Dict] = []
+    issues: List[PlanIssue] = []
+    cursor = 0
+    for w in workers:
+        n = len(w.model_config)
+        expected = model_cfg[cursor:cursor + n]
+        if [_canon(c) for c in w.model_config] != \
+                [_canon(c) for c in expected]:
+            got = [c.get("layer_type", "?") for c in w.model_config[:3]]
+            want = [c.get("layer_type", "?") for c in expected[:3]]
+            issues.append(PlanIssue(
+                "coverage", "error",
+                f"worker rank {w.rank} holds a slice that is not the "
+                f"contiguous layers {cursor}..{cursor + n - 1} of the "
+                f"model config (got {got}..., expected {want}...) — "
+                f"the partition is shuffled or overlapping"
+            ))
+        slices.append(_worker_slice(w, cursor, cursor + n))
+        cursor += n
+    if cursor != len(model_cfg):
+        issues.append(PlanIssue(
+            "coverage", "error",
+            f"the partition covers {cursor} of {len(model_cfg)} layers "
+            f"— every layer must be owned by exactly one worker "
+            f"(run an allocator, or fix the slice bounds)"
+        ))
+    if issues:
+        # a broken cover makes the downstream checks meaningless
+        report = PlanReport(
+            issues=issues, checks=["coverage"],
+            stages=len(slices), layers=len(model_cfg),
+        )
+        return report
+    report = _verify_slices(
+        model_cfg, slices, example_inputs,
+        layer_mem=layer_mem, memory=memory, check_shapes=check_shapes,
+        check_donation=check_donation, param_scale=param_scale,
+    )
+    report.checks.insert(0, "coverage")
+    return report
+
+
+def _unwrap_model(model):
+    """The verifiable PipelineModel behind ``model``, or None.
+
+    A :class:`~..parallel.data_parallel.DataParallelPipeline` is
+    unwrapped to its first replica: every replica is built from the SAME
+    worker manager and parameter server, so one replica's plan is the
+    plan.  The single source of model-type detection — ``Runner`` asks
+    :func:`has_plan` (same logic) rather than re-deriving it.
+    """
+    if hasattr(model, "_worker_manager"):
+        return model
+    replicas = getattr(model, "replicas", None)
+    if replicas and hasattr(replicas[0], "_worker_manager"):
+        return replicas[0]
+    return None
+
+
+def has_plan(model) -> bool:
+    """True when :func:`verify_pipeline` can verify this model type."""
+    return _unwrap_model(model) is not None
+
+
+def verify_pipeline(
+    model,
+    example_inputs,
+    *,
+    memory: str = "warn",
+    check_donation: bool = True,
+    param_scale: int = 2,
+) -> PlanReport:
+    """Verify a built :class:`~..parallel.pipeline.PipelineModel`'s plan
+    (the Runner-startup entry point).  The INTENDED model config is the
+    parameter server's — it was constructed with the ground-truth layer
+    list — so this is the full :func:`verify_plan` contract, including
+    shuffled/non-contiguous cover detection.  Replica wrappers are
+    unwrapped (see :func:`_unwrap_model`) and verified against the
+    per-replica batch shard — each replica sees 1/R of the leading axis,
+    so checking the full batch would overstate memory Rx and miss
+    shard-divisibility breaks.  Use :func:`has_plan` to test
+    verifiability first."""
+    unwrapped = _unwrap_model(model)
+    if unwrapped is None:
+        raise TypeError(
+            "verify_pipeline needs a PipelineModel (or a replica wrapper "
+            "around one); got a model with no worker manager"
+        )
+    if unwrapped is not model:
+        num_replicas = len(model.replicas)
+        sharded = []
+        for a in _avals(example_inputs):
+            if not a.shape or a.shape[0] % num_replicas:
+                axis = a.shape[0] if a.shape else "(scalar)"
+                return PlanReport(
+                    issues=[PlanIssue(
+                        "shape", "error",
+                        f"batch axis {axis} is not divisible by the "
+                        f"wrapper's {num_replicas} replicas — "
+                        f"_split_replicas will reject this batch at the "
+                        f"first step"
+                    )],
+                    checks=["shapes"],
+                    stages=0, layers=0,
+                )
+            sharded.append(jax.ShapeDtypeStruct(
+                (a.shape[0] // num_replicas,) + tuple(a.shape[1:]),
+                a.dtype,
+            ))
+        example_inputs = tuple(sharded)
+    model = unwrapped
+    wm = model._worker_manager
+    intended = getattr(model._parameter_server, "_model_config", None)
+    if intended is not None:
+        return verify_plan(
+            list(intended), wm, example_inputs,
+            memory=memory, check_donation=check_donation,
+            param_scale=param_scale,
+        )
+    # parameter store without a retained config: reconstruct from the
+    # slices; coverage degrades to the layer-count check
+    model_cfg: List[Dict] = []
+    slices: List[Dict] = []
+    for w in _stage_workers(wm):
+        start = len(model_cfg)
+        model_cfg.extend(w.model_config)
+        slices.append(_worker_slice(w, start, len(model_cfg)))
+    num_layers = model._parameter_server.num_layers
+    if len(model_cfg) != num_layers:
+        return PlanReport(
+            issues=[PlanIssue(
+                "coverage", "error",
+                f"workers cover {len(model_cfg)} layers but the "
+                f"parameter server holds {num_layers}"
+            )],
+            checks=["coverage"],
+            stages=len(slices), layers=num_layers,
+        )
+    report = _verify_slices(
+        model_cfg, slices, example_inputs,
+        memory=memory, check_donation=check_donation,
+        param_scale=param_scale,
+    )
+    report.checks.insert(0, "coverage")
+    return report
+
+
+# --------------------------------------------------------------------------
+# elastic re-form payload schema
+# --------------------------------------------------------------------------
+
+
+def verify_allocation_payload(payload: Any) -> List[str]:
+    """Validate a ``realloc.json`` / ``SKYTPU_ALLOCATION`` payload.
+
+    Returns a list of precise problems (empty = valid).  The schema is
+    what :class:`~..runner.hooks_collection.selfheal_hook.SelfHealHook`
+    stages and the relaunched trainer consumes: ``device_scale`` (stable
+    stim_index -> positive finite multiplier) required; optional
+    ``measured_stage_times`` (positive finite seconds), ``epoch`` /
+    ``iter`` (non-negative ints)."""
+    problems: List[str] = []
+    if not isinstance(payload, dict):
+        return [
+            f"allocation payload must be a JSON object, got "
+            f"{type(payload).__name__}"
+        ]
+    scales = payload.get("device_scale")
+    if scales is None:
+        problems.append(
+            "missing required key 'device_scale' "
+            "({stim_index: speed multiplier})"
+        )
+    elif not isinstance(scales, dict):
+        problems.append(
+            f"'device_scale' must be an object, got "
+            f"{type(scales).__name__}"
+        )
+    else:
+        for k, v in scales.items():
+            try:
+                int(k)
+            except (TypeError, ValueError):
+                problems.append(
+                    f"device_scale key {k!r} is not a stable worker "
+                    f"index (must parse as int)"
+                )
+            if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                    or not math.isfinite(float(v)) or float(v) <= 0:
+                problems.append(
+                    f"device_scale[{k!r}] = {v!r} is not a positive "
+                    f"finite speed multiplier"
+                )
+    times = payload.get("measured_stage_times")
+    if times is not None:
+        if not isinstance(times, list):
+            problems.append(
+                f"'measured_stage_times' must be a list, got "
+                f"{type(times).__name__}"
+            )
+        else:
+            for i, t in enumerate(times):
+                if isinstance(t, bool) or not isinstance(t, (int, float)) \
+                        or not math.isfinite(float(t)) or float(t) <= 0:
+                    problems.append(
+                        f"measured_stage_times[{i}] = {t!r} is not a "
+                        f"positive finite duration"
+                    )
+    for key in ("epoch", "iter"):
+        v = payload.get(key)
+        if v is not None and (
+                isinstance(v, bool) or not isinstance(v, int) or v < 0):
+            problems.append(
+                f"'{key}' must be a non-negative int, got {v!r}"
+            )
+    return problems
+
+
+__all__ = [
+    "PlanError",
+    "PlanIssue",
+    "PlanReport",
+    "has_plan",
+    "verify_allocation_payload",
+    "verify_pipeline",
+    "verify_plan",
+]

@@ -106,14 +106,19 @@ class Estimator:
             for x in data
         )
 
+        k_params, k_dropout = jax.random.split(rng)
         variables_aval = jax.eval_shape(
-            lambda *xs: module.init({"params": rng, "dropout": rng}, *xs),
+            lambda *xs: module.init(
+                {"params": k_params, "dropout": k_dropout}, *xs
+            ),
             *avals,
         )
         params_aval = variables_aval["params"]
 
         def apply_fn(params, *xs):
-            return module.apply({"params": params}, *xs, rngs={"dropout": rng})
+            return module.apply(
+                {"params": params}, *xs, rngs={"dropout": k_dropout}
+            )
 
         out_aval = jax.eval_shape(apply_fn, params_aval, *avals)
 
@@ -145,14 +150,17 @@ class Estimator:
             else x
             for x in data
         )
+        k_params, k_dropout = jax.random.split(rng)
         variables_aval = jax.eval_shape(
-            lambda *xs: module.init({"params": rng, "dropout": rng}, *xs),
+            lambda *xs: module.init(
+                {"params": k_params, "dropout": k_dropout}, *xs
+            ),
             *avals,
         )
         params_aval = variables_aval["params"]
         out_aval = jax.eval_shape(
             lambda params, *xs: module.apply(
-                {"params": params}, *xs, rngs={"dropout": rng}
+                {"params": params}, *xs, rngs={"dropout": k_dropout}
             ),
             params_aval, *avals,
         )
@@ -199,13 +207,18 @@ class Estimator:
         data = _as_tuple(data)
         if device is not None:
             data = tuple(jax.device_put(x, device) for x in data)
-        variables = module.init({"params": rng, "dropout": rng}, *data)
+        k_params, k_dropout = jax.random.split(rng)
+        variables = module.init(
+            {"params": k_params, "dropout": k_dropout}, *data
+        )
         params = variables["params"]
         if device is not None:
             params = jax.device_put(params, device)
 
         def apply_fn(params, *xs):
-            return module.apply({"params": params}, *xs, rngs={"dropout": rng})
+            return module.apply(
+                {"params": params}, *xs, rngs={"dropout": k_dropout}
+            )
 
         # Time what a pipeline stage computes each tick: the forward
         # OUTPUTS (handed downstream — returned so XLA cannot dead-code

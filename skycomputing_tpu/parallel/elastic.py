@@ -217,6 +217,21 @@ class FileRendezvous:
                 f"realloc payload unreadable ({exc}); leaving it staged"
             )
             return None
+        if payload is not None:
+            # schema validation BEFORE the payload can reach world.json:
+            # a malformed realloc.json (hand-edited, version-skewed, or
+            # torn by a dying writer) must be rejected with a precise
+            # diagnostic here, not crash every relaunched trainer's
+            # allocator
+            from ..analysis.plan_check import verify_allocation_payload
+
+            problems = verify_allocation_payload(payload)
+            if problems:
+                self._logger.info(
+                    "rejecting malformed realloc payload: "
+                    + "; ".join(problems)
+                )
+                payload = None
         try:
             os.remove(path)
         except OSError:
@@ -376,8 +391,24 @@ class ElasticSupervisor:
         # its last known allocation on crash re-forms (form_world
         # fallback), so the shared spec stays the single source of truth.
         if spec.get("allocation") is not None:
-            self._last_allocation = spec["allocation"]
-            env["SKYTPU_ALLOCATION"] = json.dumps(spec["allocation"])
+            # defense in depth: take_payload validates on the coordinator,
+            # but a non-coordinator reads world.json as published — if a
+            # skewed/older coordinator embedded a malformed allocation,
+            # reject it HERE rather than launch a trainer that dies
+            # parsing SKYTPU_ALLOCATION after its compile bill
+            from ..analysis.plan_check import verify_allocation_payload
+
+            problems = verify_allocation_payload(spec["allocation"])
+            if problems:
+                self._logger.info(
+                    f"[node {self.node_id}] ignoring malformed "
+                    f"allocation in world.json (gen "
+                    f"{spec['generation']}): " + "; ".join(problems)
+                )
+                env.pop("SKYTPU_ALLOCATION", None)
+            else:
+                self._last_allocation = spec["allocation"]
+                env["SKYTPU_ALLOCATION"] = json.dumps(spec["allocation"])
         else:
             env.pop("SKYTPU_ALLOCATION", None)
         # fast dead-peer detection so a lost node surfaces as a trainer

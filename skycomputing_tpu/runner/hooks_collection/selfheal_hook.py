@@ -246,6 +246,11 @@ class SelfHealHook(Hook):
             attribute="devices",
         )
         runner.model.rebuild()
+        # the world changed: re-arm the runner's pre-flight so the NEW
+        # plan is abstractly verified before its first train step — a
+        # broken re-allocation must surface as a diagnostic, not as a
+        # mid-run compile failure
+        runner.rearm_preflight()
         self.heals += 1
         self._record(
             runner, "heal",

@@ -40,6 +40,7 @@ class Runner:
         timer_cfg: Optional[Dict] = None,
         logging_cfg: Optional[Dict] = None,
         seed: int = 0,
+        preflight: bool = True,
     ):
         self.model = model
         self.parameter_server = parameter_server
@@ -58,6 +59,13 @@ class Runner:
         self._max_iters = max_iters
         self._stop = False
         self._rng = jax.random.key(seed)
+        # pre-flight plan verification (analysis/plan_check): abstractly
+        # check stage-boundary shapes, memory fit and donation aliasing
+        # against the first real batch BEFORE the first train step — i.e.
+        # before any XLA compile.  SKYTPU_PREFLIGHT=0 (or preflight=False)
+        # opts out.
+        self._preflight_enabled = preflight
+        self._preflight_done = False
 
         self._logger = Logger(**(logging_cfg or {}))
         self._timer = DistributedTimer(**(timer_cfg or {}))
@@ -132,6 +140,54 @@ class Runner:
     def restore_rng(self, key_data) -> None:
         self._rng = jax.random.wrap_key_data(jax.numpy.asarray(key_data))
 
+    # --- pre-flight ---------------------------------------------------------
+    def rearm_preflight(self) -> None:
+        """Re-run plan verification before the next train step.
+
+        Called after anything that changes the plan mid-run (the
+        SelfHealHook's in-process re-allocation rebuild): the NEW
+        allocation must be verified exactly like the original was.
+        """
+        self._preflight_done = False
+
+    def _preflight(self, data) -> None:
+        """One-time abstract plan verification against the first batch.
+
+        Runs before the first ``train_step`` (jit compiles lazily, so
+        this is before any compile): a malformed allocation — a stage
+        boundary that doesn't type-check, an over-budget slice, a
+        donation alias that cannot hold — is rejected here with a
+        precise diagnostic instead of minutes later inside XLA.
+        """
+        if self._preflight_done:
+            return
+        import os
+
+        if not self._preflight_enabled or \
+                os.environ.get("SKYTPU_PREFLIGHT", "1") == "0":
+            self._preflight_done = True
+            return
+        from ..analysis.plan_check import has_plan, verify_pipeline
+
+        if not has_plan(self.model):
+            # a model type that exposes no allocation (no worker manager,
+            # not a replica wrapper) has no plan to verify
+            self._logger.info(
+                f"pre-flight: skipped — "
+                f"{type(self.model).__name__} exposes no allocation"
+            )
+            self._preflight_done = True
+            return
+        report = verify_pipeline(self.model, data)
+        for issue in report.issues:
+            self._logger.info(f"pre-flight: {issue.format()}")
+        # done only on success: a rejected plan must be re-verified on a
+        # retried train() even when the caller fixed it without going
+        # through rearm_preflight
+        report.raise_if_failed()
+        self._preflight_done = True
+        self._logger.info(f"pre-flight: {report.summary()}")
+
     # --- hooks --------------------------------------------------------------
     def register_hook(self, hook: Hook) -> None:
         assert isinstance(hook, Hook)
@@ -177,6 +233,7 @@ class Runner:
                     f"epoch: {self._epoch}, iter: {self._iter}"
                 )
                 self.current_batch = (data, labels)
+                self._preflight(data)
                 self._call_hook("before_train_iter")
 
                 self._rng, step_rng = jax.random.split(self._rng)

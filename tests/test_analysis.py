@@ -1,0 +1,733 @@
+"""Static-analysis subsystem: skylint rules + the pre-flight plan verifier.
+
+Per rule ID: one known-violation fixture that MUST fire and one clean
+fixture that MUST stay silent.  Plan-verifier side: the three malformed
+plans the acceptance bar names (shape mismatch, over-memory,
+non-contiguous/incomplete cover) are rejected with actionable
+diagnostics BEFORE any dispatch, and the real launch paths (Runner
+pre-flight, payload validation in the elastic re-form) are exercised.
+
+The whole module carries the ``lint`` marker: it is the fast tier-1
+lint gate (the self-lint test keeps ``skycomputing_tpu/`` green against
+the repo's own rules).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from skycomputing_tpu.analysis.lint import (
+    LintConfig,
+    RULES,
+    lint_paths,
+    lint_source,
+)
+from skycomputing_tpu.analysis.plan_check import (
+    PlanError,
+    verify_allocation_payload,
+    verify_pipeline,
+    verify_plan,
+)
+from skycomputing_tpu.dynamics import ParameterServer, WorkerManager
+
+pytestmark = pytest.mark.lint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------------------------
+# skylint: one violation + one clean fixture per rule
+# --------------------------------------------------------------------------
+
+FIXTURES = {
+    "SKY001": (
+        # hot-path sync: .item() and float() on a dispatched value
+        '''
+def train_step(model, data):
+    loss = model.train_step(data)
+    log(float(loss))
+    return loss.item()
+''',
+        # clean: the read happens after the step's block
+        '''
+import jax
+def train_step(model, data):
+    loss = model.train_step(data)
+    jax.block_until_ready(loss)
+    return float(loss)
+''',
+    ),
+    "SKY002": (
+        # jit evaluated per loop iteration + traced branching
+        '''
+import jax
+def run(xs):
+    for x in xs:
+        f = jax.jit(lambda a: a + 1)
+        f(x)
+
+@jax.jit
+def g(x):
+    if x > 3:
+        return x
+    return -x
+''',
+        # clean: hoisted jit, lax.cond for the branch
+        '''
+import jax
+_f = jax.jit(lambda a: a + 1)
+
+def run(xs):
+    for x in xs:
+        _f(x)
+
+@jax.jit
+def g(x):
+    return jax.lax.select(x > 3, x, -x)
+''',
+    ),
+    "SKY003": (
+        # key reuse across streams, stale key after split
+        '''
+import jax
+def bad(module, rng, x):
+    v = module.init({"params": rng, "dropout": rng}, x)
+    k1, k2 = jax.random.split(rng)
+    y = module.apply(v, x, rngs={"dropout": rng})
+    return y, k1, k2
+''',
+        # clean: split halves per stream, fold_in derivation allowed
+        '''
+import jax
+def good(module, rng, x):
+    k_params, k_dropout = jax.random.split(rng)
+    v = module.init({"params": k_params, "dropout": k_dropout}, x)
+    y = module.apply(v, x, rngs={"dropout": jax.random.fold_in(rng, 1)})
+    return y
+''',
+    ),
+    "SKY004": (
+        # donated buffer read after the donating call
+        '''
+import jax
+step_donating = jax.jit(lambda p, g: p - g, donate_argnums=(0,))
+def apply_grads(params, grads):
+    new = step_donating(params, grads)
+    stale = params["w"]
+    return new, stale
+''',
+        # clean: caller rebinds to the output, donated arg never re-read
+        '''
+import jax
+step_donating = jax.jit(lambda p, g: p - g, donate_argnums=(0,))
+def apply_grads(params, grads):
+    params = step_donating(params, grads)
+    return params
+''',
+    ),
+    "SKY005": (
+        # timing across a jitted call with no block
+        '''
+import time, jax
+def bench(fn, x):
+    jitted = jax.jit(fn)
+    t0 = time.perf_counter()
+    y = jitted(x)
+    return time.perf_counter() - t0
+''',
+        # clean: block before reading the clock
+        '''
+import time, jax
+def bench(fn, x):
+    jitted = jax.jit(fn)
+    t0 = time.perf_counter()
+    y = jitted(x)
+    jax.block_until_ready(y)
+    return time.perf_counter() - t0
+''',
+    ),
+    "SKY006": (
+        '''
+import jax
+def f(x):
+    jax.debug.print("x={}", x)
+    breakpoint()
+    return x
+''',
+        '''
+import jax
+def f(x):
+    return x
+''',
+    ),
+    "SKY007": (
+        # unit config without layer_type
+        '''
+from skycomputing_tpu.builder import build_layer_stack
+stack = build_layer_stack([{"features": 8}, dict(depth=2)])
+''',
+        '''
+from skycomputing_tpu.builder import build_layer_stack
+stack = build_layer_stack([
+    {"layer_type": "MatmulStack", "features": 8},
+    dict(layer_type="MatmulStack", depth=2),
+])
+''',
+    ),
+    "SKY008": (
+        # raw .apply result star-unpacked
+        '''
+def thread(m1, m2, p1, p2, x):
+    out = m1.apply(p1, x)
+    return m2.apply(p2, *out)
+''',
+        # clean: as_tuple rewrap before threading
+        '''
+from skycomputing_tpu.builder import as_tuple
+def thread(m1, m2, p1, p2, x):
+    out = m1.apply(p1, x)
+    out = as_tuple(out)
+    return m2.apply(p2, *out)
+''',
+    ),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(RULES))
+def test_rule_fires_on_violation_fixture(rule):
+    bad, _clean = FIXTURES[rule]
+    findings = lint_source(bad, f"violation_{rule}.py")
+    assert rule in {f.rule for f in findings}, (
+        f"{rule} must fire on its violation fixture; got {findings}"
+    )
+    # every finding carries an actionable fixit
+    assert all(f.fixit for f in findings)
+
+
+@pytest.mark.parametrize("rule", sorted(RULES))
+def test_rule_silent_on_clean_fixture(rule):
+    _bad, clean = FIXTURES[rule]
+    findings = [
+        f for f in lint_source(clean, f"clean_{rule}.py")
+        if f.rule == rule
+    ]
+    assert findings == [], (
+        f"{rule} must stay silent on its clean fixture; got {findings}"
+    )
+
+
+def test_sky003_loop_threading_rebind_is_clean():
+    """`rng, sub = jax.random.split(rng)` in a loop is the canonical
+    threading pattern (SKY003's own fixit recommends it) — neither the
+    dead-split nor the stale-use check may fire on it."""
+    src = '''
+import jax
+def sample(rng, n):
+    outs = []
+    for i in range(n):
+        rng, sub = jax.random.split(rng)
+        outs.append(jax.random.normal(sub, (4,)))
+    return outs
+'''
+    findings = [f for f in lint_source(src, "loop.py")
+                if f.rule == "SKY003"]
+    assert findings == [], findings
+
+
+def test_sky003_closure_consumed_keys_are_live():
+    """Keys consumed only inside a nested function (the closure idiom)
+    are real uses — must not be reported as dead splits."""
+    src = '''
+import jax
+def make_sampler(rng):
+    k1, k2 = jax.random.split(rng)
+
+    def sample(shape):
+        return jax.random.normal(k1, shape) + jax.random.normal(k2, shape)
+
+    return sample
+'''
+    findings = [f for f in lint_source(src, "closure.py")
+                if f.rule == "SKY003"]
+    assert findings == [], findings
+
+
+def test_sky005_dispatch_exemption_survives_wrapped_assignment():
+    """The dispatch-named-target escape hatch must hold when the
+    assignment wraps across lines (normal ~72-col formatting)."""
+    src = '''
+import time, jax
+def issue_loop(fns, x):
+    t0 = time.perf_counter()
+    for f in fns:
+        x = jax.jit(f)(x)
+    stats_dispatch_s = (
+        time.perf_counter() - t0
+    )
+    return stats_dispatch_s
+'''
+    findings = [f for f in lint_source(src, "wrapped.py")
+                if f.rule == "SKY005"]
+    assert findings == [], findings
+
+
+def test_suppression_comment_silences_a_finding():
+    bad, _ = FIXTURES["SKY001"]
+    suppressed = bad.replace(
+        "return loss.item()",
+        "return loss.item()  # skylint: disable=SKY001",
+    )
+    findings = lint_source(suppressed, "sup.py")
+    assert all(
+        not (f.rule == "SKY001" and "item" in f.message) for f in findings
+    )
+    # but the suppressed finding is still visible on request
+    cfg = LintConfig(include_suppressed=True)
+    vis = lint_source(suppressed, "sup.py", cfg)
+    assert any(f.suppressed for f in vis)
+
+
+def test_suppression_in_string_literal_is_inert():
+    """Prose MENTIONING the suppression syntax (docstrings, fixture
+    strings) must not disable rules — only real comments count."""
+    src = (
+        '"""Docs: use `# skylint: disable-file=SKY006` to suppress."""\n'
+        "import pdb\n"
+    )
+    findings = lint_source(src, "prose.py")
+    assert any(f.rule == "SKY006" for f in findings), findings
+
+
+def test_file_level_suppression():
+    bad, _ = FIXTURES["SKY006"]
+    findings = lint_source(
+        "# skylint: disable-file=SKY006\n" + bad, "filesup.py"
+    )
+    assert not any(f.rule == "SKY006" for f in findings)
+
+
+def test_parse_failure_is_a_fatal_finding():
+    findings = lint_source("def broken(:\n", "broken.py")
+    assert [f.rule for f in findings] == ["SKY000"]
+
+
+def test_unreadable_file_is_a_fatal_finding(tmp_path):
+    """Non-UTF8 bytes must fail the gate as SKY000, not crash the
+    linter (and json consumers) with a raw UnicodeDecodeError."""
+    from skycomputing_tpu.analysis.lint import lint_file
+
+    bad = tmp_path / "latin1.py"
+    bad.write_bytes(b"# comment \xe9\nx = 1\n")
+    findings = lint_file(str(bad))
+    assert [f.rule for f in findings] == ["SKY000"]
+    assert "cannot be read" in findings[0].message
+
+
+def test_self_lint_gate_is_green():
+    """The repo's own library tree passes its own linter — the satellite
+    contract: violations are FIXED, not suppressed wholesale."""
+    findings = lint_paths([os.path.join(REPO_ROOT, "skycomputing_tpu")])
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_cli_exit_codes_and_json(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(FIXTURES["SKY006"][0])
+    clean = tmp_path / "clean.py"
+    clean.write_text(FIXTURES["SKY006"][1])
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.skylint", str(bad), "--format=json"],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=env,
+    )
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is False
+    assert payload["counts"].get("SKY006", 0) >= 1
+    assert all(
+        {"rule", "path", "line", "message", "fixit"} <= set(f)
+        for f in payload["findings"]
+    )
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.skylint", str(clean), "--strict"],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.skylint", str(clean),
+         "--select=SKY999", "--strict"],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=env,
+    )
+    assert proc.returncode == 2  # unknown rule id is fatal under --strict
+
+
+# --------------------------------------------------------------------------
+# plan verifier
+# --------------------------------------------------------------------------
+
+N_UNITS = 8
+
+
+def _model_cfg(features=32):
+    return [
+        dict(layer_type="MatmulStack", features=features, depth=2)
+        for _ in range(N_UNITS)
+    ]
+
+
+def _wm(counts, mem_limit=None):
+    wm = WorkerManager()
+    wm.load_worker_pool_from_config([
+        dict(
+            name=f"n{i}",
+            device_config=dict(device_index=0),
+            extra_config=(
+                dict(mem_limit=mem_limit) if mem_limit is not None else {}
+            ),
+        )
+        for i in range(len(counts))
+    ])
+    cfg = _model_cfg()
+    cursor = 0
+    for w, c in zip(wm.worker_pool, counts):
+        w.model_config = cfg[cursor:cursor + c]
+        w.order = w.rank + 1
+        cursor += c
+    return wm
+
+
+X = np.ones((4, 32), np.float32)
+
+
+def test_good_plan_passes_all_checks():
+    report = verify_plan(_model_cfg(), _wm([3, 3, 2]), (X,))
+    assert report.ok, report.summary()
+    assert {"coverage", "shapes", "memory", "donation"} <= set(report.checks)
+    report.raise_if_failed()  # no-op on a good plan
+
+
+def test_rejects_incomplete_cover():
+    report = verify_plan(_model_cfg(), _wm([3, 3, 1]), (X,))
+    assert not report.ok
+    [issue] = report.errors
+    assert issue.code == "coverage"
+    assert "7 of 8 layers" in issue.message
+    with pytest.raises(PlanError, match="coverage"):
+        report.raise_if_failed()
+
+
+def test_rejects_shuffled_noncontiguous_cover():
+    # distinct per-layer configs so a swap is detectable content-wise
+    cfg = [
+        dict(layer_type="MatmulStack", features=16 + i, depth=1)
+        for i in range(N_UNITS)
+    ]
+    wm = _wm([4, 4])
+    a, b = wm.worker_pool
+    a.model_config = cfg[4:]
+    b.model_config = cfg[:4]
+    report = verify_plan(cfg, wm, (np.ones((4, 16), np.float32),))
+    assert not report.ok
+    assert all(i.code == "coverage" for i in report.errors)
+    assert "not the contiguous layers" in report.errors[0].message
+
+
+def test_rejects_over_memory_plan():
+    report = verify_plan(
+        _model_cfg(), _wm([4, 4], mem_limit=0.01), (X,), memory="error"
+    )
+    assert not report.ok
+    assert all(i.code == "memory" for i in report.errors)
+    # actionable: names the worker, the need, the budget, and the ratio
+    assert "budget" in report.errors[0].message
+    assert "x over" in report.errors[0].message
+
+
+def test_over_memory_downgrades_to_warning_on_request():
+    report = verify_plan(
+        _model_cfg(), _wm([4, 4], mem_limit=0.01), (X,), memory="warn"
+    )
+    assert report.ok  # warnings don't fail the plan
+    assert report.warnings and report.warnings[0].code == "memory"
+
+
+def test_mismatched_layer_mem_profile_degrades_with_diagnostic():
+    """A memory profile at the wrong granularity (fewer entries than
+    layers) must surface as a diagnostic plus a traced-estimate
+    fallback, not crash the verifier with an IndexError."""
+    report = verify_plan(
+        _model_cfg(), _wm([3, 3, 2], mem_limit=1000.0), (X,),
+        layer_mem=[0.1] * (N_UNITS - 2), memory="warn",
+    )
+    assert report.ok, report.summary()
+    assert any(i.code == "memory" and "does not match" in i.message
+               for i in report.warnings)
+    assert "memory" in report.checks  # the fit ran on traced estimates
+    rep_err = verify_plan(
+        _model_cfg(), _wm([3, 3, 2]), (X,),
+        layer_mem=[0.1] * (N_UNITS - 2), memory="error",
+    )
+    assert not rep_err.ok
+    assert rep_err.errors[0].code == "memory"
+
+
+def test_rejects_shape_mismatch_plan():
+    # Conv2d needs NCHW 4-D input; a 2-D activation from MatmulStack
+    # cannot thread into it — caught abstractly, zero FLOPs
+    cfg = [
+        dict(layer_type="MatmulStack", features=32, depth=1),
+        dict(layer_type="Conv2d", in_channels=3, out_channels=4),
+    ]
+    wm = WorkerManager()
+    wm.load_worker_pool_from_config([
+        dict(name="n0", device_config=dict(device_index=0)),
+        dict(name="n1", device_config=dict(device_index=0)),
+    ])
+    wm.worker_pool[0].model_config = cfg[:1]
+    wm.worker_pool[1].model_config = cfg[1:]
+    report = verify_plan(cfg, wm, (X,))
+    assert not report.ok
+    [issue] = report.errors
+    assert issue.code == "shape"
+    # diagnostic is precise: the failing layer, its owner, the boundary
+    # signature it rejected
+    assert "layer 1" in issue.message
+    assert "Conv2d" in issue.message
+    assert "worker rank 1" in issue.message
+    assert "(4, 32)" in issue.message
+
+
+def test_memory_check_respects_param_scale_across_cached_traces():
+    """The trace cache stores raw memory components; a verification at a
+    different param_scale must not reuse another scale's totals."""
+    rep2 = verify_plan(
+        _model_cfg(), _wm([4, 4], mem_limit=0.5), (X,),
+        memory="error", param_scale=2,
+    )
+    rep100 = verify_plan(
+        _model_cfg(), _wm([4, 4], mem_limit=0.5), (X,),
+        memory="error", param_scale=100,
+    )
+    assert rep2.ok, rep2.summary()
+    assert not rep100.ok and rep100.errors[0].code == "memory"
+
+
+def test_donation_check_runs_without_shapes_check():
+    """check_donation=True must be honored even when the caller opts out
+    of the shapes report and supplies layer_mem (the threading still
+    runs because donation consumes the threaded avals)."""
+    report = verify_plan(
+        _model_cfg(), _wm([3, 3, 2]), (X,),
+        layer_mem=[0.1] * N_UNITS, check_shapes=False,
+        check_donation=True,
+    )
+    assert report.ok
+    assert "donation" in report.checks
+    assert "shapes" not in report.checks
+
+
+def test_shape_diagnostic_survives_empty_exception_message():
+    """A layer raising a bare exception during the trace must surface as
+    the precise plan diagnostic, not crash the verifier's formatter."""
+    from skycomputing_tpu.analysis.plan_check import _exc_line
+
+    assert _exc_line(ValueError()) == "(no message)"
+    assert _exc_line(ValueError("boom\nmore")) == "boom"
+
+
+def test_verifier_runs_abstractly_without_devices_warmup():
+    # well under the <1s launch-cost bar on the test instance, and
+    # repeat verification is near-free (module-global trace cache)
+    import time as _time
+
+    verify_plan(_model_cfg(), _wm([3, 3, 2]), (X,))
+    t0 = _time.perf_counter()
+    verify_plan(_model_cfg(), _wm([2, 3, 3]), (X,))
+    assert _time.perf_counter() - t0 < 1.0
+
+
+# --------------------------------------------------------------------------
+# launch-path wiring
+# --------------------------------------------------------------------------
+
+
+def _build_pipeline(counts):
+    from skycomputing_tpu.ops import cross_entropy_loss
+    from skycomputing_tpu.parallel import PipelineModel
+
+    cfg = _model_cfg()
+    wm = _wm(counts)
+    ps = ParameterServer(cfg, example_inputs=(X,), rng=jax.random.key(0))
+    model = PipelineModel(wm, ps, optax.sgd(1e-2), cross_entropy_loss)
+    return model, ps, wm
+
+
+def test_verify_pipeline_on_built_model():
+    model, _ps, _wm_ = _build_pipeline([3, 3, 2])
+    report = verify_pipeline(model, (X,))
+    assert report.ok, report.summary()
+
+
+def test_verify_pipeline_shards_replica_wrapper_batch():
+    """A DP wrapper's replicas each run 1/R of the leading axis, so the
+    verifier must thread the per-replica shard (full-batch threading
+    would overstate memory Rx) and reject a batch the wrapper's
+    _split_replicas would choke on at the first step."""
+    from skycomputing_tpu.ops import cross_entropy_loss
+    from skycomputing_tpu.parallel import DataParallelPipeline
+
+    cfg = _model_cfg()
+    wm = _wm([3, 3, 2])
+    ps = ParameterServer(cfg, example_inputs=(X,), rng=jax.random.key(0))
+    dp = DataParallelPipeline(
+        wm, ps, optax.sgd(1e-2), cross_entropy_loss, num_replicas=2
+    )
+    report = verify_pipeline(dp, (X,))  # batch 4 -> shard 2 per replica
+    assert report.ok, report.summary()
+
+    report = verify_pipeline(dp, (np.ones((5, 32), np.float32),))
+    assert not report.ok
+    [issue] = report.errors
+    assert issue.code == "shape"
+    assert "divisible" in issue.message
+
+
+def test_verify_pipeline_rejects_shuffled_cover():
+    """The Runner-path verifier compares slices against the parameter
+    server's INTENDED config, so a permuted partition — layers applied
+    to the wrong parameter positions — is rejected even when every
+    boundary happens to type-check."""
+    from skycomputing_tpu.ops import cross_entropy_loss
+    from skycomputing_tpu.parallel import PipelineModel
+
+    # depths 1..6: every layer distinct, but all boundaries are
+    # (4, 32) -> (4, 32), so only the cover check can catch a shuffle
+    cfg = [
+        dict(layer_type="MatmulStack", features=32, depth=1 + i)
+        for i in range(6)
+    ]
+    wm = WorkerManager()
+    wm.load_worker_pool_from_config([
+        dict(name=f"n{i}", device_config=dict(device_index=0))
+        for i in range(2)
+    ])
+    wm.worker_pool[0].model_config = cfg[:3]
+    wm.worker_pool[1].model_config = cfg[3:]
+    ps = ParameterServer(cfg, example_inputs=(X,), rng=jax.random.key(0))
+    model = PipelineModel(wm, ps, optax.sgd(1e-2), cross_entropy_loss)
+    # post-build permutation: every boundary still type-checks (same
+    # shapes everywhere) but the layer->param correspondence is wrong
+    a, b = wm.worker_pool
+    a.model_config, b.model_config = b.model_config, a.model_config
+    report = verify_pipeline(model, (X,))
+    assert not report.ok
+    assert all(i.code == "coverage" for i in report.errors)
+
+
+def test_runner_preflight_rejects_tampered_plan():
+    from skycomputing_tpu.runner import Runner
+
+    model, ps, wm = _build_pipeline([3, 3, 2])
+    runner = Runner(model, ps, wm, max_epochs=1, max_iters=1)
+    # a post-build tamper (the class of bug a bad re-form introduces):
+    # worker 0 silently drops a layer — the cover no longer matches the
+    # parameter server
+    dropped = wm.worker_pool[0].model_config
+    wm.worker_pool[0].model_config = dropped[:2]
+    labels = np.zeros((4,), np.int32)
+    with pytest.raises(PlanError, match="coverage"):
+        runner.train([((X,), labels)])
+    assert runner.iter == 0  # rejected before the first step
+    # a failed pre-flight must NOT latch done: the still-broken plan is
+    # re-verified on a retried train(), and a caller-side fix (outside
+    # rearm_preflight) is picked up and verified too
+    with pytest.raises(PlanError, match="coverage"):
+        runner.train([((X,), labels)])
+    wm.worker_pool[0].model_config = dropped
+    runner.train([((X,), labels)])
+    assert runner.iter == 1
+
+
+def test_runner_preflight_passes_and_trains():
+    from skycomputing_tpu.runner import Runner
+
+    model, ps, wm = _build_pipeline([3, 3, 2])
+    runner = Runner(model, ps, wm, max_epochs=1, max_iters=1)
+    labels = np.zeros((4,), np.int32)
+    runner.train([((X,), labels)])
+    assert runner.iter == 1
+
+
+def test_runner_preflight_opt_out():
+    from skycomputing_tpu.runner import Runner
+
+    model, ps, wm = _build_pipeline([3, 3, 2])
+    runner = Runner(model, ps, wm, max_epochs=1, max_iters=1,
+                    preflight=False)
+    wm.worker_pool[0].model_config = wm.worker_pool[0].model_config[:2]
+    labels = np.zeros((4,), np.int32)
+    # with preflight off the tamper is NOT caught up front (the engine
+    # itself doesn't consult the worker manager again until a rebuild)
+    runner.train([((X,), labels)])
+    assert runner.iter == 1
+
+
+# --------------------------------------------------------------------------
+# elastic re-form payload schema
+# --------------------------------------------------------------------------
+
+
+def test_payload_schema_accepts_real_selfheal_payload():
+    assert verify_allocation_payload(
+        {
+            "device_scale": {"2": 3.0, "0": 1.0},
+            "measured_stage_times": [0.5, 1.5],
+            "epoch": 0,
+            "iter": 17,
+        }
+    ) == []
+
+
+@pytest.mark.parametrize(
+    "payload,needle",
+    [
+        ([1, 2], "must be a JSON object"),
+        ({}, "missing required key 'device_scale'"),
+        ({"device_scale": 3.0}, "'device_scale' must be an object"),
+        ({"device_scale": {"x": 2.0}}, "not a stable worker index"),
+        ({"device_scale": {"0": -1.0}}, "positive finite"),
+        ({"device_scale": {"0": float("nan")}}, "positive finite"),
+        (
+            {"device_scale": {"0": 2.0},
+             "measured_stage_times": [0.1, "a"]},
+            "measured_stage_times[1]",
+        ),
+        ({"device_scale": {"0": 2.0}, "iter": -1}, "'iter' must be"),
+    ],
+)
+def test_payload_schema_rejects_malformed(payload, needle):
+    problems = verify_allocation_payload(payload)
+    assert problems, f"expected rejection for {payload!r}"
+    assert any(needle in p for p in problems), problems
+
+
+def test_rendezvous_discards_malformed_payload(tmp_path):
+    from skycomputing_tpu.parallel.elastic import FileRendezvous
+
+    rdv = FileRendezvous(str(tmp_path), node_id=0)
+    rdv.stage_payload({"device_scale": {"0": -5.0}})
+    assert rdv.take_payload() is None  # rejected with a logged diagnostic
+    assert not os.path.exists(os.path.join(str(tmp_path), "realloc.json"))
+
+    rdv.stage_payload({"device_scale": {"0": 2.0}, "iter": 3})
+    payload = rdv.take_payload()
+    assert payload == {"device_scale": {"0": 2.0}, "iter": 3}

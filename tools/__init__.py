@@ -1,0 +1,2 @@
+# Makes ``python -m tools.skylint`` work; the scripts in here also run
+# directly (``python tools/<name>.py``).

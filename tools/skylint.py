@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""skylint CLI: the repo's JAX-hazard linter.
+
+Usage::
+
+    python -m tools.skylint skycomputing_tpu/ --strict
+    python -m tools.skylint path/a.py path/b.py --format=json
+    python -m tools.skylint skycomputing_tpu/ --select=SKY003,SKY005
+
+Exit codes: 0 clean, 1 findings, 2 bad invocation.  A file that does not
+parse is always rc 1 (rule SKY000).  Under ``--strict`` an unknown rule
+ID in ``--select``/``--ignore`` is a fatal bad invocation (rc 2) instead
+of silently matching nothing.
+
+``--format=json`` prints a machine-consumable object::
+
+    {"findings": [{rule, path, line, col, message, fixit}...],
+     "counts": {"SKY001": 2, ...}, "ok": false}
+
+The rule catalog lives in ``docs/static_analysis.md``; suppression is
+``# skylint: disable=SKY00X`` on the finding's line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Load the lint engine by file path instead of importing the package:
+# analysis/lint.py is pure stdlib, while the package __init__ pulls in
+# jax — a lint gate should start in milliseconds and run on machines
+# (or CI jobs) with no accelerator stack installed at all.
+_spec = importlib.util.spec_from_file_location(
+    "skylint_engine",
+    os.path.join(_ROOT, "skycomputing_tpu", "analysis", "lint.py"),
+)
+_engine = importlib.util.module_from_spec(_spec)
+# dataclasses resolves string annotations through sys.modules[__module__];
+# register before exec or the @dataclass decorators fail on py3.10
+sys.modules["skylint_engine"] = _engine
+_spec.loader.exec_module(_engine)
+LintConfig = _engine.LintConfig
+RULES = _engine.RULES
+lint_paths = _engine.lint_paths
+
+
+def _parse_rule_set(spec: str, strict: bool) -> set:
+    ids = {s.strip().upper() for s in spec.split(",") if s.strip()}
+    unknown = ids - set(RULES) - {"SKY000"}
+    if unknown:
+        msg = f"unknown rule id(s): {', '.join(sorted(unknown))}"
+        if strict:
+            print(f"skylint: error: {msg}", file=sys.stderr)
+            raise SystemExit(2)
+        print(f"skylint: warning: {msg}", file=sys.stderr)
+    return ids
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="skylint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("paths", nargs="+",
+                    help="files and/or directories to lint")
+    ap.add_argument("--strict", action="store_true",
+                    help="fail on unknown rule ids; intended for CI gates")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--ignore", default="",
+                    help="comma-separated rule ids to skip")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also report suppressed findings (marked)")
+    args = ap.parse_args(argv)
+
+    for p in args.paths:
+        if not os.path.exists(p):
+            print(f"skylint: error: no such path: {p}", file=sys.stderr)
+            return 2
+
+    config = LintConfig(
+        select=_parse_rule_set(args.select, args.strict)
+        if args.select else None,
+        ignore=_parse_rule_set(args.ignore, args.strict)
+        if args.ignore else set(),
+        include_suppressed=args.show_suppressed,
+    )
+    findings = lint_paths(args.paths, config)
+    active = [f for f in findings if not f.suppressed]
+
+    if args.format == "json":
+        counts: dict = {}
+        for f in active:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "counts": counts,
+            "ok": not active,
+        }, indent=2))
+    else:
+        for f in findings:
+            tag = " (suppressed)" if f.suppressed else ""
+            print(f.format() + tag)
+        if active:
+            print(f"skylint: {len(active)} finding(s) in "
+                  f"{len({f.path for f in active})} file(s)",
+                  file=sys.stderr)
+        else:
+            print("skylint: clean", file=sys.stderr)
+
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

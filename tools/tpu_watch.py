@@ -16,7 +16,19 @@ Every probe attempt is appended to ``logs/tpu_watch.jsonl`` either way —
 the probe log is itself the artifact proving the tunnel never answered
 (VERDICT r03 task #3 asks for exactly that on a dead tunnel).
 
-Usage:  python tools/tpu_watch.py [--once] [--interval 300] [--max-hours 11]
+A watch window lasts ``--max-hours``; when it expires the watcher no
+longer gives up permanently (round 5's watcher died 2026-07-31 and
+nothing would have caught the chip coming back): it RE-ARMS — the probe
+interval backs off by ``--backoff`` (capped at ``--max-interval``) and a
+fresh window starts, forever unless ``--max-rearms`` bounds it.  At
+launch, a stale log tail (last event older than ``--stale-warn-hours``)
+is called out loudly: a long-dead watcher means the tunnel may have
+revived unobserved, so consumers of the log's dead-probe evidence
+(bench.py's probe-ladder shortcut) must not trust it.
+
+Usage:  python tools/tpu_watch.py [--once] [--interval 300]
+        [--max-hours 11] [--backoff 2.0] [--max-interval 3600]
+        [--max-rearms 0 (unlimited)] [--stale-warn-hours 6]
 """
 
 from __future__ import annotations
@@ -145,19 +157,75 @@ def run_evidence_batch(info: dict) -> None:
             log_event({"run": name, "rc": "timeout", "budget_s": budget})
 
 
+def warn_if_log_stale(stale_warn_hours: float) -> None:
+    """At launch: call out a long-dead predecessor watcher.
+
+    The log's dead-probe entries are EVIDENCE other tools consume
+    (bench.py shortcuts its probe ladder on a fresh "hung" line); once
+    the tail goes stale that evidence is void — the tunnel may have
+    revived unobserved.  Log it as its own event so post-mortems can see
+    exactly how large the observation gap was.
+    """
+    last_ts = None
+    try:
+        with open(LOG) as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                last_ts = rec.get("ts", last_ts)
+    except OSError:
+        return
+    if last_ts is None:
+        return
+    try:
+        from datetime import datetime
+
+        age_h = (datetime.now()
+                 - datetime.fromisoformat(last_ts)).total_seconds() / 3600
+    except ValueError:
+        return
+    if age_h > stale_warn_hours:
+        log_event({
+            "watcher": "stale_log_warning",
+            "last_event_ts": last_ts,
+            "gap_hours": round(age_h, 1),
+            "note": (
+                "no watcher observed the tunnel for this gap — the chip "
+                "may have come back unobserved; dead-probe evidence older "
+                "than the gap must not be trusted"
+            ),
+        })
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--once", action="store_true",
                     help="single probe, no loop")
     ap.add_argument("--interval", type=float, default=300.0)
     ap.add_argument("--probe-timeout", type=float, default=150.0)
-    ap.add_argument("--max-hours", type=float, default=11.0)
+    ap.add_argument("--max-hours", type=float, default=11.0,
+                    help="length of one watch window (re-arms after)")
+    ap.add_argument("--backoff", type=float, default=2.0,
+                    help="probe-interval multiplier applied per re-arm")
+    ap.add_argument("--max-interval", type=float, default=3600.0,
+                    help="cap on the backed-off probe interval")
+    ap.add_argument("--max-rearms", type=int, default=0,
+                    help="0 = re-arm forever; N = give up after N re-arms")
+    ap.add_argument("--stale-warn-hours", type=float, default=6.0,
+                    help="warn at launch if the log tail is older than this")
     args = ap.parse_args()
 
+    warn_if_log_stale(args.stale_warn_hours)
+    interval = args.interval
+    rearms = 0
     deadline = time.time() + args.max_hours * 3600
-    log_event({"watcher": "start", "interval_s": args.interval,
+    log_event({"watcher": "start", "interval_s": interval,
                "probe_timeout_s": args.probe_timeout,
-               "max_hours": args.max_hours})
+               "max_hours": args.max_hours, "backoff": args.backoff,
+               "max_interval_s": args.max_interval,
+               "max_rearms": args.max_rearms})
     while True:
         info = probe(args.probe_timeout)
         if info is not None and info.get("platform") != "cpu":
@@ -168,11 +236,25 @@ def main() -> int:
             # backend answered but it's CPU — no tunnel to seize
             log_event({"watcher": "backend is cpu; nothing to seize"})
             return 1
-        if args.once or time.time() > deadline:
-            log_event({"watcher": "giving up", "reason":
-                       "once" if args.once else "max-hours reached"})
+        if args.once:
+            log_event({"watcher": "giving up", "reason": "once"})
             return 2
-        time.sleep(args.interval)
+        if time.time() > deadline:
+            # window expired: re-arm with a backed-off cadence instead of
+            # dying — a permanently-dead watcher is how round 5 missed
+            # any chance of catching the chip coming back
+            if args.max_rearms and rearms >= args.max_rearms:
+                log_event({"watcher": "giving up",
+                           "reason": "max-rearms reached",
+                           "rearms": rearms})
+                return 2
+            rearms += 1
+            interval = min(interval * args.backoff, args.max_interval)
+            deadline = time.time() + args.max_hours * 3600
+            log_event({"watcher": "re-arm", "rearm": rearms,
+                       "interval_s": interval,
+                       "next_window_hours": args.max_hours})
+        time.sleep(interval)
 
 
 if __name__ == "__main__":
