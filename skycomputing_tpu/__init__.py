@@ -36,6 +36,7 @@ from .dynamics import (
 )
 from .parallel import PipelineModel, StageRuntime
 from .runner import Hook, Runner
+from .serving import Request, ServingEngine
 from .stimulator import Stimulator
 
 __all__ = [
@@ -69,6 +70,8 @@ __all__ = [
     "StageRuntime",
     "Hook",
     "Runner",
+    "Request",
+    "ServingEngine",
     "Stimulator",
     "__version__",
 ]

@@ -262,6 +262,75 @@ def _worker_slice(worker, start: int, end: int) -> Dict:
     )
 
 
+def _serving_kv_profile(
+    model_cfg: List[Dict], serving: Dict, issues: List[PlanIssue],
+    memory: str,
+) -> Optional[List[float]]:
+    """Per-layer KV-slab MB for a serving context, or None if the
+    context is unusable (a diagnostic is appended).
+
+    ``serving``: ``slots`` (required), ``max_len`` (required),
+    ``bucket`` (optional, reported in diagnostics), ``kv_mb_per_layer``
+    (optional explicit profile — must match the model length; computed
+    from the config via the engine's own slab formula otherwise).
+    """
+    severity = "error" if memory == "error" else "warning"
+    try:
+        slots = int(serving["slots"])
+        max_len = int(serving["max_len"])
+    except (KeyError, TypeError, ValueError):
+        issues.append(PlanIssue(
+            "memory", severity,
+            f"serving context must carry integer 'slots' and 'max_len' "
+            f"(got {serving!r}) — cannot account for KV-slab memory"
+        ))
+        return None
+    explicit = serving.get("kv_mb_per_layer")
+    if explicit is not None:
+        # a validator that crashes on malformed input defeats itself:
+        # a non-list profile or non-numeric entry degrades to a precise
+        # diagnostic, exactly like the layer_mem length-mismatch path
+        if not isinstance(explicit, (list, tuple)):
+            issues.append(PlanIssue(
+                "memory", severity,
+                f"serving kv_mb_per_layer must be a list of per-layer "
+                f"MB, got {type(explicit).__name__}"
+            ))
+            return None
+        if len(explicit) != len(model_cfg):
+            issues.append(PlanIssue(
+                "memory", severity,
+                f"serving kv_mb_per_layer holds {len(explicit)} entries "
+                f"for {len(model_cfg)} layers — the KV profile does not "
+                f"match this model config"
+            ))
+            return None
+        try:
+            return [float(m) for m in explicit]
+        except (TypeError, ValueError):
+            issues.append(PlanIssue(
+                "memory", severity,
+                f"serving kv_mb_per_layer entries must be numbers, got "
+                f"{explicit!r}"
+            ))
+            return None
+    from ..serving.kv_cache import kv_mb_per_layer
+
+    return kv_mb_per_layer(model_cfg, slots, max_len)
+
+
+def _serving_label(serving: Dict) -> str:
+    bucket = serving.get("bucket")
+    try:
+        tail = f", bucket {int(bucket)}" if bucket is not None else ""
+    except (TypeError, ValueError):
+        tail = f", bucket {bucket!r}"
+    return (
+        f"{int(serving['slots'])} KV slots x max_len "
+        f"{int(serving['max_len'])}{tail}"
+    )
+
+
 def _verify_slices(
     model_cfg: List[Dict],
     slices: List[Dict],
@@ -272,13 +341,22 @@ def _verify_slices(
     check_shapes: bool = True,
     check_donation: bool = True,
     param_scale: int = 2,
+    serving: Optional[Dict] = None,
 ) -> PlanReport:
     """Shared engine.  ``slices``: dicts with keys ``label`` (e.g.
     'worker rank 3'), ``start``, ``end``, ``mem_budget_mb`` (None = no
-    budget configured)."""
+    budget configured).  ``serving`` (optional): the engine's operating
+    point — per-stage preallocated KV slabs then count against the
+    budgets, and memory diagnostics name the serving context."""
     t0 = time.perf_counter()
     report = PlanReport(stages=len(slices), layers=len(model_cfg))
     issues = report.issues
+
+    kv_per_layer: Optional[List[float]] = None
+    if serving is not None:
+        kv_per_layer = _serving_kv_profile(
+            model_cfg, serving, issues, memory
+        )
 
     # ---- shape threading + per-layer memory, deduped by structure
     if layer_mem is not None and len(layer_mem) != len(model_cfg):
@@ -352,15 +430,30 @@ def _verify_slices(
         for s in slices:
             budget = s.get("mem_budget_mb")
             need = float(sum(mem_per_layer[s["start"]:s["end"]]))
+            kv_need = 0.0
+            if kv_per_layer is not None:
+                kv_need = float(sum(kv_per_layer[s["start"]:s["end"]]))
+                need += kv_need
             if budget is None:
                 continue
             if need > float(budget):
+                # a serving failure names its operating point: the fix
+                # is usually fewer slots / shorter max_len, not a
+                # different partition, and the message must say which
+                detail = ""
+                if kv_per_layer is not None:
+                    detail = (
+                        f" (serving {_serving_label(serving)}: "
+                        f"preallocated KV slabs are {kv_need:.6g} MB "
+                        f"of the need)"
+                    )
                 issues.append(PlanIssue(
                     "memory", "error" if memory == "error" else "warning",
                     f"{s['label']} (layers {s['start']}..{s['end'] - 1}) "
                     f"needs {need:.6g} MB but its budget is "
                     f"{float(budget):.6g} MB "
                     f"({need / float(budget):.2f}x over)"
+                    f"{detail}"
                 ))
 
     # ---- donation aliasing (needs the threaded avals)
@@ -429,6 +522,7 @@ def verify_plan(
     check_shapes: bool = True,
     check_donation: bool = True,
     param_scale: int = 2,
+    serving: Optional[Dict] = None,
 ) -> PlanReport:
     """Verify an allocation written onto a ``WorkerManager`` against the
     intended ``model_cfg`` (coverage + contiguity + the abstract checks).
@@ -436,6 +530,13 @@ def verify_plan(
     ``memory``: 'error' | 'warn' | 'skip' — over-budget slices either
     fail the plan, surface as warnings (the bench's even baseline
     deliberately ignores budgets), or are not checked.
+
+    ``serving``: optional serving operating point (``slots``,
+    ``max_len``, optional ``bucket`` / explicit ``kv_mb_per_layer``) —
+    each stage's preallocated KV slabs then count against its budget,
+    and a failed fit names the serving context (this is the engine's
+    pre-launch check: slabs allocate eagerly at construction, so an
+    over-budget plan must die before any compile).
     """
     workers = _stage_workers(worker_manager)
     slices: List[Dict] = []
@@ -475,6 +576,7 @@ def verify_plan(
         model_cfg, slices, example_inputs,
         layer_mem=layer_mem, memory=memory, check_shapes=check_shapes,
         check_donation=check_donation, param_scale=param_scale,
+        serving=serving,
     )
     report.checks.insert(0, "coverage")
     return report
@@ -509,6 +611,7 @@ def verify_pipeline(
     memory: str = "warn",
     check_donation: bool = True,
     param_scale: int = 2,
+    serving: Optional[Dict] = None,
 ) -> PlanReport:
     """Verify a built :class:`~..parallel.pipeline.PipelineModel`'s plan
     (the Runner-startup entry point).  The INTENDED model config is the
@@ -555,7 +658,7 @@ def verify_pipeline(
         return verify_plan(
             list(intended), wm, example_inputs,
             memory=memory, check_donation=check_donation,
-            param_scale=param_scale,
+            param_scale=param_scale, serving=serving,
         )
     # parameter store without a retained config: reconstruct from the
     # slices; coverage degrades to the layer-count check
@@ -579,7 +682,7 @@ def verify_pipeline(
     report = _verify_slices(
         model_cfg, slices, example_inputs,
         memory=memory, check_donation=check_donation,
-        param_scale=param_scale,
+        param_scale=param_scale, serving=serving,
     )
     report.checks.insert(0, "coverage")
     return report
@@ -599,6 +702,19 @@ def verify_allocation_payload(payload: Any) -> List[str]:
     stim_index -> positive finite multiplier) required; optional
     ``measured_stage_times`` (positive finite seconds), ``epoch`` /
     ``iter`` (non-negative ints)."""
+    def finite_pos(v) -> bool:
+        # NB: a hand-edited payload can carry an arbitrary-precision
+        # JSON integer; float() of a >1e308 int raises OverflowError,
+        # and a validator that crashes on malformed input defeats
+        # itself — classify it as not-a-valid-multiplier instead
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return False
+        try:
+            f = float(v)
+        except OverflowError:
+            return False
+        return math.isfinite(f) and f > 0
+
     problems: List[str] = []
     if not isinstance(payload, dict):
         return [
@@ -625,8 +741,7 @@ def verify_allocation_payload(payload: Any) -> List[str]:
                     f"device_scale key {k!r} is not a stable worker "
                     f"index (must parse as int)"
                 )
-            if isinstance(v, bool) or not isinstance(v, (int, float)) \
-                    or not math.isfinite(float(v)) or float(v) <= 0:
+            if not finite_pos(v):
                 problems.append(
                     f"device_scale[{k!r}] = {v!r} is not a positive "
                     f"finite speed multiplier"
@@ -640,8 +755,7 @@ def verify_allocation_payload(payload: Any) -> List[str]:
             )
         else:
             for i, t in enumerate(times):
-                if isinstance(t, bool) or not isinstance(t, (int, float)) \
-                        or not math.isfinite(float(t)) or float(t) <= 0:
+                if not finite_pos(t):
                     problems.append(
                         f"measured_stage_times[{i}] = {t!r} is not a "
                         f"positive finite duration"
@@ -653,6 +767,67 @@ def verify_allocation_payload(payload: Any) -> List[str]:
             problems.append(
                 f"'{key}' must be a non-negative int, got {v!r}"
             )
+    serving = payload.get("serving")
+    if serving is not None:
+        problems.extend(_verify_serving_payload(serving))
+    return problems
+
+
+def _pos_int(v) -> bool:
+    return (
+        not isinstance(v, bool) and isinstance(v, int) and v > 0
+    )
+
+
+def _verify_serving_payload(serving: Any) -> List[str]:
+    """Problems with a payload's optional ``serving`` operating point.
+
+    Schema: ``slots`` / ``max_len`` positive ints (required — the
+    relaunched engine preallocates its slabs from them), optional
+    ``buckets`` a strictly increasing list of positive ints none of
+    which exceeds ``max_len`` (a bucket past the slab depth would admit
+    prompts the cache cannot hold).
+    """
+    if not isinstance(serving, dict):
+        return [
+            f"'serving' must be an object, got {type(serving).__name__}"
+        ]
+    problems: List[str] = []
+    for key in ("slots", "max_len"):
+        v = serving.get(key)
+        if not _pos_int(v):
+            problems.append(
+                f"serving.{key} must be a positive int (KV slot pool "
+                f"shape), got {v!r}"
+            )
+    buckets = serving.get("buckets")
+    if buckets is not None:
+        if not isinstance(buckets, list) or not buckets:
+            problems.append(
+                f"serving.buckets must be a non-empty list of prompt "
+                f"buckets, got {buckets!r}"
+            )
+        else:
+            for i, b in enumerate(buckets):
+                if not _pos_int(b):
+                    problems.append(
+                        f"serving.buckets[{i}] = {b!r} is not a "
+                        f"positive int"
+                    )
+            ints = [b for b in buckets if _pos_int(b)]
+            if ints != sorted(set(ints)):
+                problems.append(
+                    f"serving.buckets {buckets!r} must be strictly "
+                    f"increasing (each prompt pads to the smallest "
+                    f"bucket that holds it)"
+                )
+            max_len = serving.get("max_len")
+            if ints and _pos_int(max_len) and ints[-1] > max_len:
+                problems.append(
+                    f"serving.buckets largest bucket {ints[-1]} "
+                    f"exceeds serving.max_len {max_len} — prompts "
+                    f"padded past the KV slab depth"
+                )
     return problems
 
 

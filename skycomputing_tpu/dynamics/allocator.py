@@ -140,6 +140,40 @@ class Allocator:
                 pos += 1
         return self._apply_partition(worker_ranks, ranges, orders)
 
+    # --------------------------------------------------------------- serving
+    def serving_allocate(
+        self, decode_benchmarker, max_time: float = 300
+    ) -> WorkerManager:
+        """Bottleneck-optimal partition for DECODE-step serving load.
+
+        Same solver, different physics: the contiguous min-max machinery
+        behind :meth:`optimal_allocate` (exact subset/class DP, anneal
+        fallback) is profile-agnostic, so serving balance is obtained by
+        swapping the per-layer profile — ``decode_benchmarker`` (a
+        :class:`~..serving.profile.DecodeModelBenchmarker`) supplies one
+        decode iteration's FLOPs as cost and params + preallocated
+        KV-slab MB as memory, instead of the training fwd+bwd numbers.
+        A training partition balances matmul-heavy FFN slices; a decode
+        partition must also balance the attention units' O(max_len)
+        cache reads and FIT each stage's slabs under ``mem_limit`` —
+        reusing training costs mis-loads both.
+
+        Any training-calibrated cost override
+        (:meth:`calibrate_costs` and friends) is stashed for the solve:
+        those corrections were learned at training granularity and
+        would silently distort the decode profile.  The device-speed
+        override stays — node degradation is workload-independent.
+        """
+        saved_bench = self._model_benchmarker
+        saved_override = self._cost_override
+        self._model_benchmarker = decode_benchmarker
+        self._cost_override = None
+        try:
+            return self.optimal_allocate(max_time=max_time)
+        finally:
+            self._model_benchmarker = saved_bench
+            self._cost_override = saved_override
+
     # ----------------------------------------------------- closed-loop refine
     def calibrate_costs(
         self, stage_layer_counts, measured_stage_times,

@@ -17,7 +17,7 @@ TPU-native replacement for the reference ``Estimator``
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Sequence, Tuple
+from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -177,6 +177,87 @@ class Estimator:
         """XLA-reported FLOPs of an arbitrary jittable function."""
         compiled = jax.jit(fn).lower(*args).compile()
         return float(_cost_analysis(compiled).get("flops", 0.0))
+
+    @staticmethod
+    def benchmark_decode_step(
+        module,
+        data: Sequence[Any],
+        cache_avals: Optional[Sequence[Any]] = None,
+        index: Any = None,
+        param_scale: int = 2,
+        rng: jax.Array = None,
+    ):
+        """(out_avals, flops, mem_MB) for ONE decode iteration — static.
+
+        The serving counterpart of :meth:`benchmark_model`: training
+        costs (full-sequence fwd+bwd) mis-rank layers for a *decode*
+        partition, where attention is dominated by the KV-cache read
+        (``O(max_len)`` per token) and everything else by ``Lq=1``
+        matmuls.  This profiles the layer's actual per-token program:
+
+        - attention-style layers (``cache_avals`` given): the layer's
+          ``decode(data..., k_cache, v_cache, index)`` method against
+          the full slot slab;
+        - embedding-style layers (a ``decode`` method, no caches):
+          ``decode(data..., index)``;
+        - everything else: plain ``apply``.
+
+        Like :meth:`benchmark_model`, everything is abstract — shapes
+        via ``eval_shape``, FLOPs from XLA's cost model — so a deep
+        stack profiles without materializing parameters.  ``mem_MB``
+        is the reference accounting formula (inputs + 2x outputs +
+        ``param_scale`` x params, 4 bytes); the *preallocated KV-slab*
+        memory is deliberately not included here — it is a pool-level
+        quantity added by the serving profile
+        (:func:`~..serving.kv_cache.kv_mb_per_layer`), which keeps one
+        slab-size formula shared with the pre-flight plan verifier.
+        """
+        if rng is None:
+            rng = jax.random.key(0)
+        data = _as_tuple(data)
+        avals = tuple(
+            jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype)
+            if not isinstance(x, jax.ShapeDtypeStruct)
+            else x
+            for x in data
+        )
+        method = None
+        args = avals
+        if cache_avals is not None:
+            method = type(module).decode
+            args = avals + tuple(cache_avals) + (index,)
+        elif hasattr(module, "decode"):
+            method = type(module).decode
+            args = avals + (index,)
+
+        k_params, k_dropout = jax.random.split(rng)
+        variables_aval = jax.eval_shape(
+            lambda *xs: module.init(
+                {"params": k_params, "dropout": k_dropout}, *xs,
+                method=method,
+            ),
+            *args,
+        )
+        params_aval = variables_aval["params"]
+
+        def step_fn(params, *xs):
+            return module.apply({"params": params}, *xs, method=method)
+
+        out_aval = jax.eval_shape(step_fn, params_aval, *args)
+        compiled = jax.jit(step_fn).lower(params_aval, *args).compile()
+        flops = float(_cost_analysis(compiled).get("flops", 0.0))
+
+        # memory counts the DATA outputs only: an attention decode also
+        # returns the updated caches, but those alias the preallocated
+        # slab (in-place update), not fresh per-step activations
+        data_out = out_aval[0] if cache_avals is not None else out_aval
+        mb = 1024.0**2
+        mem_usage = (
+            _aval_bytes(avals, 4.0) / mb
+            + 2.0 * _aval_bytes(data_out, 4.0) / mb
+            + param_scale * _aval_bytes(params_aval, 4.0) / mb
+        )
+        return out_aval, flops, mem_usage
 
     @staticmethod
     def benchmark_train_time(

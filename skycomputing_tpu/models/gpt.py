@@ -134,11 +134,14 @@ class GptEmbeddings(nn.Module):
     def decode(self, input_ids, index):
         """Embed ``input_ids`` [B, Lq] occupying positions index..index+Lq-1.
 
+        ``index`` may be a scalar (all rows at the same offset) or a [B]
+        vector (continuous batching: every slot at its own position).
         Dropout is never applied (decoding is inference).
         """
-        seq_len = input_ids.shape[1]
-        positions = index + jnp.arange(seq_len, dtype=jnp.int32)
-        return self.wte(input_ids) + self.wpe(positions[None, :])
+        from ..serving.kv_cache import decode_positions
+
+        positions = decode_positions(index, input_ids.shape[1])
+        return self.wte(input_ids) + self.wpe(positions)
 
 
 @LAYER.register_module
@@ -202,29 +205,28 @@ class GptBlock_Attn(nn.Module):
         """One incremental step: update the fixed-shape KV cache, attend.
 
         ``hidden``: [B, Lq, H] new positions index..index+Lq-1;
-        ``k_cache``/``v_cache``: [B, max_len, heads, head_dim].
+        ``k_cache``/``v_cache``: [B, max_len, heads, head_dim] slabs
+        (see ``serving/kv_cache.py`` — the one KV-cache implementation);
+        ``index`` scalar or [B] per-slot vector.
         Returns (new_hidden, k_cache, v_cache).
         """
+        from ..serving.kv_cache import decode_visibility, update_kv_cache
+
         cfg = _gcfg(self.config)
         dtype = jnp.dtype(cfg.dtype)
         head_dim = cfg.hidden_size // cfg.num_attention_heads
         q, k_new, v_new = self._qkv(hidden)
 
-        k_cache = jax.lax.dynamic_update_slice(
-            k_cache, k_new.astype(k_cache.dtype), (0, index, 0, 0)
-        )
-        v_cache = jax.lax.dynamic_update_slice(
-            v_cache, v_new.astype(v_cache.dtype), (0, index, 0, 0)
+        k_cache, v_cache = update_kv_cache(
+            k_cache, v_cache, k_new, v_new, index
         )
 
         scores = jnp.einsum(
             "blhd,bmhd->bhlm", q, k_cache.astype(dtype)
         ) / jnp.sqrt(jnp.asarray(head_dim, dtype))
         Lq, max_len = q.shape[1], k_cache.shape[1]
-        q_pos = index + jnp.arange(Lq, dtype=jnp.int32)
-        k_pos = jnp.arange(max_len, dtype=jnp.int32)
-        visible = k_pos[None, :] <= q_pos[:, None]  # [Lq, max_len]
-        scores = jnp.where(visible[None, None], scores, -jnp.inf)
+        visible = decode_visibility(index, Lq, max_len)  # [B|1, Lq, max]
+        scores = jnp.where(visible[:, None], scores, -jnp.inf)
         probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
             dtype
         )
@@ -451,6 +453,75 @@ def generate(
     return tokens[:, :length]
 
 
+def decode_modules(modules) -> list:
+    """Validated, dropout-free module list for KV-cache decoding.
+
+    The shared preparation step for every decoding consumer (the
+    single-request :class:`CachedGptDecoder` and the serving engine's
+    stage slices): ring attention is rejected (its ppermute schedule has
+    no incremental form) and any module with a live ``deterministic``
+    knob is cloned with dropout forced off.
+    """
+    prepared = []
+    for m in list(getattr(modules, "modules", modules)):
+        if isinstance(m, GptBlock_Attn) and m.mesh is not None:
+            raise ValueError(
+                "cached decoding does not support ring attention; "
+                "build the stack with mesh=None"
+            )
+        if hasattr(m, "deterministic") and not m.deterministic:
+            m = m.clone(deterministic=True)
+        prepared.append(m)
+    return prepared
+
+
+def attn_indices(modules) -> list:
+    """Positions of the KV-cache-bearing units in a module slice."""
+    return [
+        i for i, m in enumerate(modules) if isinstance(m, GptBlock_Attn)
+    ]
+
+
+def apply_kv_cached(modules, params_list, data, caches, index):
+    """Thread one decode step through a module SLICE.
+
+    ``data`` is token ids [B, Lq] when the slice starts with
+    :class:`GptEmbeddings`, else the hidden state handed over from the
+    previous pipeline stage; ``caches`` is one (k, v) slab pair per
+    attention unit in the slice (``serving/kv_cache.py`` layout);
+    ``index`` is a scalar or a per-row [B] vector.  Returns (output,
+    updated caches).  This is the single decode-threading implementation
+    — :class:`CachedGptDecoder` runs it over the whole stack, the
+    serving engine over each stage's slice.
+    """
+    if len(params_list) != len(modules):
+        raise ValueError(
+            f"got {len(params_list)} param trees for "
+            f"{len(modules)} layers"
+        )
+    new_caches = list(caches)
+    n_attn = len(attn_indices(modules))
+    if len(new_caches) != n_attn:
+        raise ValueError(
+            f"got {len(new_caches)} cache pairs for {n_attn} "
+            f"attention units"
+        )
+    cache_i = 0
+    for module, params in zip(modules, params_list):
+        if isinstance(module, GptEmbeddings):
+            data = module.apply({"params": params}, data, index,
+                                method=GptEmbeddings.decode)
+        elif isinstance(module, GptBlock_Attn):
+            k, v = new_caches[cache_i]
+            data, k, v = module.apply({"params": params}, data, k, v,
+                                      index, method=GptBlock_Attn.decode)
+            new_caches[cache_i] = (k, v)
+            cache_i += 1
+        else:
+            data = module.apply({"params": params}, data)
+    return data, new_caches
+
+
 class CachedGptDecoder:
     """KV-cache incremental decoding over the decomposed GPT layer stack.
 
@@ -458,27 +529,15 @@ class CachedGptDecoder:
     fixed-shape full-forward ``generate`` (O(L^2) work per token).  This
     decoder reuses the *same layer modules and param trees* as the
     ``LayerStack`` the pipeline splits, but threads a fixed-shape KV cache
-    ([B, max_len, heads, head_dim] per attention unit) updated in place
-    with ``lax.dynamic_update_slice`` — O(L) work per token, one compiled
-    shape for prefill and one for the single-token step.
+    ([B, max_len, heads, head_dim] per attention unit, allocated and
+    updated by ``serving/kv_cache.py`` — the one KV-cache implementation)
+    in place — O(L) work per token, one compiled shape for prefill and
+    one for the single-token step.
     """
 
     def __init__(self, stack):
-        modules = list(getattr(stack, "modules", stack))
-        self.modules = []
-        for m in modules:
-            if isinstance(m, GptBlock_Attn) and m.mesh is not None:
-                raise ValueError(
-                    "cached decoding does not support ring attention; "
-                    "build the stack with mesh=None"
-                )
-            if hasattr(m, "deterministic") and not m.deterministic:
-                m = m.clone(deterministic=True)
-            self.modules.append(m)
-        self._attn_idx = [
-            i for i, m in enumerate(self.modules)
-            if isinstance(m, GptBlock_Attn)
-        ]
+        self.modules = decode_modules(stack)
+        self._attn_idx = attn_indices(self.modules)
         if not self._attn_idx or not isinstance(
             self.modules[0], GptEmbeddings
         ):
@@ -488,41 +547,26 @@ class CachedGptDecoder:
 
     def init_cache(self, batch: int, max_len: int):
         """Zeroed fixed-shape KV caches: [(k, v)] per attention unit."""
-        caches = []
-        for i in self._attn_idx:
-            cfg = _gcfg(self.modules[i].config)
-            head_dim = cfg.hidden_size // cfg.num_attention_heads
-            shape = (batch, max_len, cfg.num_attention_heads, head_dim)
-            dtype = jnp.dtype(cfg.dtype)
-            caches.append((jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)))
-        return caches
+        from ..serving.kv_cache import (
+            init_layer_caches,
+            kv_spec_from_config,
+        )
+
+        specs = [
+            kv_spec_from_config(_gcfg(self.modules[i].config).to_dict(),
+                                max_len)
+            for i in self._attn_idx
+        ]
+        return init_layer_caches(specs, batch)
 
     def apply_cached(self, params_list, tokens, caches, index):
         """Forward ``tokens`` [B, Lq] at positions index..index+Lq-1.
 
         Returns (logits [B, Lq, V], updated caches).
         """
-        if len(params_list) != len(self.modules):
-            raise ValueError(
-                f"got {len(params_list)} param trees for "
-                f"{len(self.modules)} layers"
-            )
-        data = tokens
-        new_caches = list(caches)
-        cache_i = 0
-        for module, params in zip(self.modules, params_list):
-            if isinstance(module, GptEmbeddings):
-                data = module.apply({"params": params}, data, index,
-                                    method=GptEmbeddings.decode)
-            elif isinstance(module, GptBlock_Attn):
-                k, v = new_caches[cache_i]
-                data, k, v = module.apply({"params": params}, data, k, v,
-                                          index, method=GptBlock_Attn.decode)
-                new_caches[cache_i] = (k, v)
-                cache_i += 1
-            else:
-                data = module.apply({"params": params}, data)
-        return data, new_caches
+        return apply_kv_cached(
+            self.modules, params_list, tokens, caches, index
+        )
 
 
 def generate_cached(
@@ -633,4 +677,7 @@ __all__ = [
     "generate",
     "generate_cached",
     "CachedGptDecoder",
+    "apply_kv_cached",
+    "attn_indices",
+    "decode_modules",
 ]

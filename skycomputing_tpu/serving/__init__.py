@@ -1,0 +1,53 @@
+"""Continuous-batching inference over the load-balanced MPMD pipeline.
+
+The training side of this repo reproduces the paper's contribution —
+profile-driven layer->device allocation for heterogeneous pipelines;
+this package is the serving side the ROADMAP's north star demands:
+
+- :mod:`.kv_cache` — the single slot-based KV-cache implementation
+  (fixed ``[slots, max_len, heads, head_dim]`` slabs, free-slot
+  allocator, donation-friendly in-place updates) that also backs
+  ``models/gpt.py``'s single-request decoder;
+- :mod:`.batcher` — shape-bucketing admission (prompt lengths padded to
+  a small fixed bucket set so steady-state decode compiles once);
+- :mod:`.engine` — :class:`ServingEngine`, iteration-level continuous
+  batching (Orca-style: requests join/leave the running batch between
+  decode steps) over pipeline stages placed by the allocator, with
+  :class:`ServingStats` SLO metrics;
+- :mod:`.profile` — :class:`DecodeModelBenchmarker`, the decode-step
+  cost/memory profile that makes ``Allocator.serving_allocate`` produce
+  serving-balanced partitions instead of reusing training costs.
+
+(``models/gpt.py``'s decode paths import ``kv_cache`` function-locally,
+so the models -> serving edge never executes at import time and the
+package can import its submodules eagerly without a cycle.)
+"""
+
+from __future__ import annotations
+
+from .batcher import AdmissionQueue, Request, ShapeBucketer
+from .engine import ServingEngine, ServingStats
+from .kv_cache import (
+    KVCacheSpec,
+    SlotKVCachePool,
+    init_layer_caches,
+    kv_mb_per_layer,
+    kv_spec_from_config,
+    update_kv_cache,
+)
+from .profile import DecodeModelBenchmarker
+
+__all__ = [
+    "AdmissionQueue",
+    "DecodeModelBenchmarker",
+    "KVCacheSpec",
+    "Request",
+    "ServingEngine",
+    "ServingStats",
+    "ShapeBucketer",
+    "SlotKVCachePool",
+    "init_layer_caches",
+    "kv_mb_per_layer",
+    "kv_spec_from_config",
+    "update_kv_cache",
+]

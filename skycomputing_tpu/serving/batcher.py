@@ -1,0 +1,220 @@
+"""Shape-bucketing admission layer for the serving engine.
+
+Serving traffic has arbitrary prompt lengths; XLA programs have fixed
+shapes.  The bridge is a small set of **prompt-length buckets**: every
+prompt is right-padded to the smallest bucket that holds it, so the
+prefill program compiles once per bucket and the steady-state decode
+program (always ``[slots, 1]``) compiles exactly once — the SKY002
+recompile discipline applied to serving.  Bucket choice trades padding
+waste (few, large buckets) against warmup compiles (many buckets);
+padding positions are attention-masked so they never change a token.
+
+Admission is FIFO with same-bucket packing: the head of the queue picks
+the bucket, and up to ``prefill_batch`` queued requests of that same
+bucket join it (skipping over other buckets WITHOUT starving them — the
+head request itself is always served first).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# request lifecycle states
+QUEUED = "queued"
+RUNNING = "running"
+FINISHED = "finished"
+
+_REQUEST_IDS = itertools.count()
+
+
+@dataclass
+class Request:
+    """One generation request and its runtime state.
+
+    ``prompt`` is the token ids; ``tokens`` accumulates generated ids as
+    the engine produces them (the per-request output stream).  After a
+    preemption the request re-enters the queue and its *effective*
+    prompt is ``prompt + tokens`` — decoding resumes by recomputing the
+    KV prefix (vLLM-style recomputation preemption), so the token
+    stream is preserved exactly.
+    """
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    temperature: float = 0.0
+    seed: int = 0
+    request_id: int = field(default_factory=lambda: next(_REQUEST_IDS))
+
+    # runtime state (owned by the engine)
+    status: str = QUEUED
+    tokens: List[int] = field(default_factory=list)
+    slot: Optional[int] = None
+    index: int = 0               # current sequence length in the cache
+    bucket: Optional[int] = None
+    preemptions: int = 0
+
+    # SLO stamps (perf_counter seconds; None until reached)
+    submitted_s: Optional[float] = None
+    first_token_s: Optional[float] = None
+    finished_s: Optional[float] = None
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError("empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}"
+            )
+
+    @property
+    def effective_prompt(self) -> np.ndarray:
+        """Prompt plus already-generated tokens (the resume prefix)."""
+        if not self.tokens:
+            return self.prompt
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)]
+        )
+
+    @property
+    def remaining(self) -> int:
+        return self.max_new_tokens - len(self.tokens)
+
+    @property
+    def done(self) -> bool:
+        return len(self.tokens) >= self.max_new_tokens
+
+    def output(self) -> np.ndarray:
+        """prompt + generated tokens (the ``generate`` output layout)."""
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)]
+        )
+
+    def ttft_s(self) -> Optional[float]:
+        if self.submitted_s is None or self.first_token_s is None:
+            return None
+        return self.first_token_s - self.submitted_s
+
+    def tpot_s(self) -> Optional[float]:
+        """Mean per-output-token latency after the first token, or None
+        when undefined (unfinished, or a single-token generation — a
+        0.0 here would drag the fleet TPOT percentiles toward zero)."""
+        if self.first_token_s is None or self.finished_s is None:
+            return None
+        n = len(self.tokens)
+        if n <= 1:
+            return None
+        return (self.finished_s - self.first_token_s) / (n - 1)
+
+
+class ShapeBucketer:
+    """Prompt lengths -> the fixed bucket set the programs compile for."""
+
+    def __init__(self, buckets: Sequence[int]):
+        cleaned = sorted(set(int(b) for b in buckets))
+        if not cleaned or cleaned[0] < 1:
+            raise ValueError(f"invalid bucket set {list(buckets)!r}")
+        self.buckets: Tuple[int, ...] = tuple(cleaned)
+
+    @property
+    def max_bucket(self) -> int:
+        return self.buckets[-1]
+
+    def bucket_for(self, length: int) -> int:
+        """Smallest bucket >= length (the pad target for a prompt)."""
+        for b in self.buckets:
+            if length <= b:
+                return b
+        raise ValueError(
+            f"prompt length {length} exceeds the largest bucket "
+            f"{self.buckets[-1]}; add a bucket or truncate"
+        )
+
+    def pad_batch(
+        self, prompts: Sequence[np.ndarray], bucket: int, rows: int,
+        pad_id: int = 0,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Right-pad ``prompts`` to [rows, bucket] + true-length vector.
+
+        Rows beyond ``len(prompts)`` are all-pad dummies (the admission
+        batch itself is a fixed shape, so a half-full admission wave
+        reuses the compiled prefill program).  Dummy lengths read 1 so a
+        gather at ``length - 1`` stays in range.
+        """
+        ids = np.full((rows, bucket), pad_id, np.int32)
+        lengths = np.ones((rows,), np.int32)
+        for i, p in enumerate(prompts):
+            p = np.asarray(p, np.int32).reshape(-1)
+            if p.size > bucket:
+                raise ValueError(
+                    f"prompt of length {p.size} does not fit bucket "
+                    f"{bucket}"
+                )
+            ids[i, : p.size] = p
+            lengths[i] = p.size
+        return ids, lengths
+
+
+class AdmissionQueue:
+    """FIFO queue with same-bucket packing for prefill waves."""
+
+    def __init__(self, bucketer: ShapeBucketer, prefill_batch: int = 1):
+        if prefill_batch < 1:
+            raise ValueError(
+                f"prefill_batch must be >= 1, got {prefill_batch}"
+            )
+        self.bucketer = bucketer
+        self.prefill_batch = int(prefill_batch)
+        self._queue: List[Request] = []
+
+    def __len__(self) -> int:
+        return len(self._queue)
+
+    @property
+    def depth(self) -> int:
+        return len(self._queue)
+
+    def submit(self, request: Request) -> None:
+        if request.submitted_s is None:
+            request.submitted_s = time.perf_counter()
+        request.status = QUEUED
+        request.bucket = self.bucketer.bucket_for(
+            int(request.effective_prompt.size)
+        )
+        self._queue.append(request)
+
+    def next_wave(self, free_slots: int) -> Optional[List[Request]]:
+        """Dequeue the next same-bucket prefill wave, or None.
+
+        The queue head fixes the bucket (FIFO — no starvation); later
+        same-bucket requests pack into the wave up to
+        ``min(prefill_batch, free_slots)``.
+        """
+        if not self._queue or free_slots < 1:
+            return None
+        head_bucket = self._queue[0].bucket
+        cap = min(self.prefill_batch, free_slots)
+        wave: List[Request] = []
+        rest: List[Request] = []
+        for r in self._queue:
+            if len(wave) < cap and r.bucket == head_bucket:
+                wave.append(r)
+            else:
+                rest.append(r)
+        self._queue = rest
+        return wave
+
+
+__all__ = [
+    "AdmissionQueue",
+    "FINISHED",
+    "QUEUED",
+    "RUNNING",
+    "Request",
+    "ShapeBucketer",
+]

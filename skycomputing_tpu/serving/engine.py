@@ -1,0 +1,604 @@
+"""ServingEngine: iteration-level continuous batching over pipeline stages.
+
+The training engine (``parallel/pipeline.py``) amortizes host dispatch
+over microbatches; serving has no microbatches — it has *requests* that
+arrive whenever they arrive and finish whenever they finish.  The two
+techniques that make a pipeline throughput-competitive for serving, both
+implemented here:
+
+- **continuous batching** (Orca, OSDI '22): scheduling happens at
+  *decode-iteration* granularity.  Every tick the engine (1) admits
+  queued requests into free KV slots via a bucketed prefill wave and
+  (2) runs ONE single-token decode step over the whole slot slab.
+  A finishing request frees its slot between ticks; a joining request
+  occupies one between ticks; the running batch never drains to
+  accommodate either — the static-batching failure mode where every
+  member waits for the slowest.
+- **slot-based KV caching** (the fixed-slab half of PagedAttention,
+  SOSP '23): per-stage preallocated ``[slots, max_len, heads,
+  head_dim]`` slabs (``serving/kv_cache.py``) give every compiled
+  program a fixed shape regardless of which requests are live.  Decode
+  compiles ONCE; prefill compiles once per prompt-length bucket
+  (``serving/batcher.py``); after warmup the steady state is
+  zero-recompile, pinned by ``xla_compile_count()`` in
+  ``tests/test_serving.py``.
+
+Pipeline integration: stages come from the same worker-manager
+allocation the MPMD trainer uses (``Allocator.serving_allocate``
+balances them against *decode-step* costs — see ``serving/profile.py``),
+each stage's params and slabs are committed to its device, and
+inter-stage hidden-state/index hops ride ``device_put_elided`` so
+same-device handoffs are free and cross-device ones batch into one put.
+
+Inactive slots ride through the decode step computing masked garbage —
+that waste is the price of a fixed shape, and ``ServingStats.
+batch_occupancy`` makes it visible instead of hidden.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..builder import build_layer_stack
+from ..models.gpt import (
+    GptEmbeddings,
+    _gcfg,
+    apply_kv_cached,
+    attn_indices,
+    decode_modules,
+)
+from ..parallel.pipeline import (
+    _donation_enabled,
+    device_put_elided,
+    xla_compile_count,
+)
+from .batcher import (
+    AdmissionQueue,
+    FINISHED,
+    RUNNING,
+    Request,
+    ShapeBucketer,
+)
+from .kv_cache import SlotKVCachePool, kv_spec_from_config
+
+
+# one compiled gather/argmax pair per (batch, vocab) shape — module-level
+# jits so every engine instance shares the executables
+_gather_last = jax.jit(
+    lambda logits, pos: logits[jnp.arange(logits.shape[0]), pos, :]
+)
+_argmax_tokens = jax.jit(
+    lambda logits: jnp.argmax(logits, axis=-1).astype(jnp.int32)
+)
+
+
+@dataclass
+class ServingStats:
+    """SLO accounting for a :class:`ServingEngine` (the serving
+    counterpart of ``PipelineStats``).
+
+    Counters are cumulative since engine construction; ``queue_depth``
+    and ``batch_occupancy`` are gauges from the last iteration.
+    ``compiles`` counts XLA backend compiles observed during engine
+    calls — after bucket warmup it must stop moving (the steady-state
+    zero-recompile contract).  ``queue_stalls`` counts iterations where
+    admission wanted a slot and none was free (the pool-exhaustion
+    queueing path); ``preemptions`` counts slot evictions
+    (recomputation-style: the request re-queues and its KV prefix is
+    rebuilt on re-admission).
+    """
+
+    iterations: int = 0
+    prefill_waves: int = 0
+    prefill_tokens: int = 0
+    decode_tokens: int = 0
+    generated_tokens: int = 0
+    admitted: int = 0
+    finished: int = 0
+    preemptions: int = 0
+    queue_stalls: int = 0
+    compiles: int = 0
+    # gauges
+    queue_depth: int = 0
+    batch_occupancy: float = 0.0
+    # blocked wall time per phase (timed across block_until_ready)
+    prefill_s: float = 0.0
+    decode_s: float = 0.0
+    # per-request SLO samples
+    ttft_s: List[float] = field(default_factory=list)
+    tpot_s: List[float] = field(default_factory=list)
+
+    def tokens_per_s(self) -> float:
+        """Generated tokens per second of engine compute wall clock."""
+        elapsed = self.prefill_s + self.decode_s
+        return self.generated_tokens / elapsed if elapsed > 0 else 0.0
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able summary (percentiles over the SLO samples)."""
+        def pct(xs, q):
+            return float(np.percentile(xs, q)) if xs else None
+
+        return dict(
+            iterations=self.iterations,
+            prefill_waves=self.prefill_waves,
+            prefill_tokens=self.prefill_tokens,
+            decode_tokens=self.decode_tokens,
+            generated_tokens=self.generated_tokens,
+            admitted=self.admitted,
+            finished=self.finished,
+            preemptions=self.preemptions,
+            queue_stalls=self.queue_stalls,
+            compiles=self.compiles,
+            queue_depth=self.queue_depth,
+            batch_occupancy=self.batch_occupancy,
+            prefill_s=self.prefill_s,
+            decode_s=self.decode_s,
+            tokens_per_s=self.tokens_per_s(),
+            ttft_p50_s=pct(self.ttft_s, 50),
+            ttft_p95_s=pct(self.ttft_s, 95),
+            tpot_p50_s=pct(self.tpot_s, 50),
+            tpot_p95_s=pct(self.tpot_s, 95),
+        )
+
+
+class _ServingStage:
+    """One pipeline stage: module slice + device + slabs + programs."""
+
+    def __init__(
+        self,
+        stage_index: int,
+        modules: Sequence[Any],
+        params: Sequence[Any],
+        device,
+        num_slots: int,
+        max_len: int,
+    ):
+        self.stage_index = stage_index
+        self.modules = list(modules)
+        self.device = device
+        self.params: List[Any] = jax.device_put(list(params), device)
+        specs = [
+            kv_spec_from_config(
+                _gcfg(self.modules[i].config).to_dict(), max_len
+            )
+            for i in attn_indices(self.modules)
+        ]
+        self.pool = SlotKVCachePool(specs, num_slots, device=device)
+        mods, stage_specs = self.modules, specs
+
+        def decode(params_list, data, caches, index):
+            return apply_kv_cached(mods, params_list, data, caches, index)
+
+        def prefill(params_list, data, slabs, slot_ids):
+            # scratch caches sized to the bucket: the prefix 0..L-1 is
+            # exactly what must land in the slabs, so the filled scratch
+            # IS the scatter payload
+            rows, bucket = data.shape[0], data.shape[1]
+            scratch = [
+                (
+                    jnp.zeros(
+                        (rows, bucket, s.num_heads, s.head_dim),
+                        jnp.dtype(s.dtype),
+                    ),
+                    jnp.zeros(
+                        (rows, bucket, s.num_heads, s.head_dim),
+                        jnp.dtype(s.dtype),
+                    ),
+                )
+                for s in stage_specs
+            ]
+            out, scratch = apply_kv_cached(
+                mods, params_list, data, scratch, 0
+            )
+            # rows assigned the sentinel slot id (padding rows of a
+            # half-full wave) drop out of the scatter entirely
+            new_slabs = [
+                (
+                    k_slab.at[slot_ids, :bucket].set(ks, mode="drop"),
+                    v_slab.at[slot_ids, :bucket].set(vs, mode="drop"),
+                )
+                for (ks, vs), (k_slab, v_slab) in zip(scratch, slabs)
+            ]
+            return out, new_slabs
+
+        # donated twins (convention: *_donated handles are consumed on
+        # call — the engine rebinds pool.slabs to the outputs on the
+        # same line).  Donation follows the backend like the training
+        # engine: in-place slab reuse pays on TPU/GPU, is inert on CPU.
+        if _donation_enabled():
+            self._decode_donated = jax.jit(decode, donate_argnums=(2,))
+            self._prefill_donated = jax.jit(prefill, donate_argnums=(2,))
+        else:
+            self._decode_donated = jax.jit(decode)
+            self._prefill_donated = jax.jit(prefill)
+
+
+class ServingEngine:
+    """Continuous-batching GPT serving over allocator-placed stages.
+
+    ``model_cfg`` is the same layer-config list every other subsystem
+    speaks (``gpt_layer_configs`` output); ``params_list`` the matching
+    per-layer param trees (``LayerStack.init`` result or
+    ``ParameterServer.get_layer_slice(0, n)``).  Stage placement comes
+    from ``worker_manager`` (an allocator-written pool, serving-balanced
+    via ``Allocator.serving_allocate``) or an explicit ``partition`` of
+    layer counts; default is one stage on the first device.
+    """
+
+    def __init__(
+        self,
+        model_cfg: Sequence[Dict],
+        params_list: Sequence[Any],
+        *,
+        num_slots: int = 4,
+        max_len: int = 128,
+        buckets: Sequence[int] = (16, 32, 64),
+        prefill_batch: int = 1,
+        pad_id: int = 0,
+        worker_manager=None,
+        partition: Optional[Sequence[int]] = None,
+        devices: Optional[Sequence[Any]] = None,
+        static_batching: bool = False,
+        preflight: bool = True,
+    ):
+        modules = decode_modules(build_layer_stack(list(model_cfg)))
+        if not attn_indices(modules) or not isinstance(
+            modules[0], GptEmbeddings
+        ):
+            raise ValueError(
+                "expected a GPT stack: GptEmbeddings + GptBlock_Attn units"
+            )
+        max_pos = _gcfg(modules[0].config).max_position_embeddings
+        if max_len > max_pos:
+            raise ValueError(
+                f"max_len={max_len} exceeds "
+                f"max_position_embeddings={max_pos}"
+            )
+        self.bucketer = ShapeBucketer(buckets)
+        if self.bucketer.max_bucket > max_len:
+            raise ValueError(
+                f"largest bucket {self.bucketer.max_bucket} exceeds "
+                f"max_len={max_len}"
+            )
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        self.pad_id = int(pad_id)
+        self._queue = AdmissionQueue(
+            self.bucketer, prefill_batch=prefill_batch
+        )
+        self.prefill_batch = int(prefill_batch)
+        # static_batching is the NAIVE baseline policy, kept on the same
+        # kernels so tools/bench_serving.py isolates the scheduling
+        # policy: requests join only at batch boundaries (when the
+        # running batch has fully drained), so every member waits for
+        # the slowest — the failure mode continuous batching removes
+        self.static_batching = bool(static_batching)
+        self.stats = ServingStats()
+        self._running: Dict[int, Request] = {}  # request_id -> Request
+        self._finished: List[Request] = []
+
+        self._devices = (
+            list(devices) if devices is not None else jax.devices()
+        )
+        counts, stage_devices = self._resolve_stage_plan(
+            worker_manager, partition, len(modules)
+        )
+        if preflight and worker_manager is not None:
+            # slabs allocate eagerly below, so an over-budget serving
+            # plan must die HERE — before any slab materializes or any
+            # stage program compiles — with the serving context named
+            from ..analysis.plan_check import verify_plan
+
+            verify_plan(
+                list(model_cfg), worker_manager,
+                (np.zeros((self.num_slots, 1), np.int32),),
+                memory="error", check_donation=False,
+                serving=dict(
+                    slots=self.num_slots, max_len=self.max_len,
+                    bucket=self.bucketer.max_bucket,
+                ),
+            ).raise_if_failed()
+        if len(params_list) != len(modules):
+            raise ValueError(
+                f"got {len(params_list)} param trees for "
+                f"{len(modules)} layers"
+            )
+        self.stages: List[_ServingStage] = []
+        cursor = 0
+        for k, (n, dev) in enumerate(zip(counts, stage_devices)):
+            self.stages.append(
+                _ServingStage(
+                    k,
+                    modules[cursor:cursor + n],
+                    list(params_list)[cursor:cursor + n],
+                    dev,
+                    self.num_slots,
+                    self.max_len,
+                )
+            )
+            cursor += n
+        self._last_device = self.stages[-1].device
+
+    # --- construction helpers ----------------------------------------------
+    def _resolve_stage_plan(self, worker_manager, partition, n_layers):
+        """(layer counts, devices) per stage, from an allocator-written
+        worker pool, an explicit partition, or the 1-stage default."""
+        if worker_manager is not None and partition is not None:
+            raise ValueError("pass worker_manager OR partition, not both")
+        if worker_manager is not None:
+            # the verifier's stage ordering (plan_check._stage_workers):
+            # rank-sorted non-empty workers — one definition, so the
+            # engine and the pre-flight can never disagree on stages
+            from ..analysis.plan_check import _stage_workers
+
+            workers = _stage_workers(worker_manager)
+            counts = [len(w.model_config) for w in workers]
+            stage_devices = [
+                self._devices[w.device_index % len(self._devices)]
+                for w in workers
+            ]
+        else:
+            counts = (
+                [int(c) for c in partition]
+                if partition is not None else [n_layers]
+            )
+            stage_devices = [
+                self._devices[k % len(self._devices)]
+                for k in range(len(counts))
+            ]
+        if sum(counts) != n_layers or any(c < 1 for c in counts):
+            raise ValueError(
+                f"partition {counts} does not cover {n_layers} layers"
+            )
+        return counts, stage_devices
+
+    # --- slot ledger (slot ids are global across stages) -------------------
+    @property
+    def free_slots(self) -> int:
+        return self.stages[0].pool.free_slots
+
+    def _allocate_slot(self) -> Optional[int]:
+        slot = self.stages[0].pool.allocate()
+        if slot is None:
+            return None
+        for st in self.stages[1:]:
+            st.pool.acquire(slot)
+        return slot
+
+    def _release_slot(self, slot: int) -> None:
+        for st in self.stages:
+            st.pool.release(slot)
+
+    # --- request lifecycle --------------------------------------------------
+    def submit(self, request: Request) -> Request:
+        """Queue a request (admitted into a slot on a later ``step``)."""
+        length = int(request.effective_prompt.size)
+        if length + request.remaining > self.max_len:
+            raise ValueError(
+                f"prompt ({length}) + new tokens ({request.remaining}) "
+                f"exceed max_len={self.max_len}"
+            )
+        self._queue.submit(request)  # raises if no bucket fits
+        self.stats.admitted += 1
+        self.stats.queue_depth = self._queue.depth
+        return request
+
+    def preempt(self, request_id: int) -> Request:
+        """Evict a running request; it re-queues and resumes by
+        recomputing its KV prefix on re-admission (token stream intact)."""
+        request = self._running.get(request_id)
+        if request is None:
+            raise KeyError(f"request {request_id} is not running")
+        # validate the resume prefix fits a bucket BEFORE touching any
+        # state: a request grown past the largest bucket cannot resume
+        # by recomputation, and a failed preempt must leave it running
+        self.bucketer.bucket_for(int(request.effective_prompt.size))
+        self._running.pop(request_id)
+        self._release_slot(request.slot)
+        request.slot = None
+        request.preemptions += 1
+        self.stats.preemptions += 1
+        self._queue.submit(request)
+        self.stats.queue_depth = self._queue.depth
+        return request
+
+    def _finish(self, request: Request, now: float) -> None:
+        self._release_slot(request.slot)
+        request.slot = None
+        request.status = FINISHED
+        request.finished_s = now
+        self._running.pop(request.request_id, None)
+        self._finished.append(request)
+        self.stats.finished += 1
+        ttft = request.ttft_s()
+        tpot = request.tpot_s()
+        if ttft is not None:
+            self.stats.ttft_s.append(ttft)
+        if tpot is not None:
+            self.stats.tpot_s.append(tpot)
+
+    # --- the continuous-batching loop ---------------------------------------
+    def has_work(self) -> bool:
+        return bool(self._running) or self._queue.depth > 0
+
+    def step(self) -> None:
+        """One engine iteration: admit prefill waves, then one decode
+        tick over the slot slab.  Requests join and leave the running
+        batch only here, between decode steps — iteration-level
+        scheduling."""
+        if self._queue.depth > 0 and self.free_slots == 0:
+            self.stats.queue_stalls += 1
+        self._admit()
+        self._decode_tick()
+        self.stats.iterations += 1
+        self.stats.queue_depth = self._queue.depth
+        self.stats.batch_occupancy = self.stages[0].pool.occupancy
+
+    def run(
+        self,
+        requests: Optional[Sequence[Request]] = None,
+        max_iterations: int = 100_000,
+    ) -> Dict[int, np.ndarray]:
+        """Drive ``step`` until the queue and batch drain; returns
+        ``{request_id: prompt + generated tokens}`` for everything that
+        finished during the call."""
+        finished0 = len(self._finished)
+        for r in requests or ():
+            self.submit(r)
+        for _ in range(max_iterations):
+            if not self.has_work():
+                break
+            self.step()
+        else:  # pragma: no cover - scheduler liveness guard
+            raise RuntimeError(
+                f"serving engine made no full drain in "
+                f"{max_iterations} iterations"
+            )
+        return {
+            r.request_id: r.output()
+            for r in self._finished[finished0:]
+        }
+
+    @property
+    def finished_requests(self) -> List[Request]:
+        return list(self._finished)
+
+    # --- internals ----------------------------------------------------------
+    def _admit(self) -> None:
+        if self.static_batching and self._running:
+            return  # batch boundary only: the naive baseline policy
+        while True:
+            wave = self._queue.next_wave(self.free_slots)
+            if not wave:
+                break
+            self._prefill_wave(wave)
+
+    def _prefill_wave(self, wave: List[Request]) -> None:
+        bucket = wave[0].bucket
+        rows = self.prefill_batch
+        ids, lengths = self.bucketer.pad_batch(
+            [r.effective_prompt for r in wave], bucket, rows, self.pad_id
+        )
+        # sentinel = num_slots: padding rows scatter out of range -> drop
+        slot_ids = np.full((rows,), self.num_slots, np.int32)
+        for i, r in enumerate(wave):
+            slot = self._allocate_slot()
+            assert slot is not None  # next_wave capped by free_slots
+            r.slot = slot
+            slot_ids[i] = slot
+
+        t0 = time.perf_counter()
+        compiles0 = xla_compile_count()
+        data: Any = ids
+        for st in self.stages:
+            data = device_put_elided(data, st.device)
+            sids = device_put_elided(slot_ids, st.device)
+            data, st.pool.slabs = st._prefill_donated(
+                st.params, data, st.pool.slabs, sids
+            )
+        pos = device_put_elided(lengths - 1, self._last_device)
+        logits = _gather_last(data, pos)  # [rows, V]
+        tokens = _argmax_tokens(logits)
+        jax.block_until_ready(tokens)
+        now = time.perf_counter()
+        self.stats.prefill_s += now - t0
+        self.stats.prefill_waves += 1
+        self.stats.prefill_tokens += int(lengths[: len(wave)].sum())
+        # per-call delta, not a process-global diff: foreign jit work in
+        # the same process must not read as engine recompiles
+        self.stats.compiles += xla_compile_count() - compiles0
+
+        tokens_np = np.asarray(tokens)
+        sampled = self._sampled_rows(
+            logits, [(i, r) for i, r in enumerate(wave)]
+        )
+        for i, r in enumerate(wave):
+            tok = self._pick_token(r, tokens_np[i], sampled.get(i))
+            r.tokens.append(tok)
+            r.index = int(lengths[i])
+            r.status = RUNNING
+            self._running[r.request_id] = r
+            if r.first_token_s is None:
+                r.first_token_s = now
+            self.stats.generated_tokens += 1
+            if r.done:
+                self._finish(r, now)
+
+    def _decode_tick(self) -> None:
+        active = list(self._running.values())
+        if not active:
+            return
+        tokens = np.zeros((self.num_slots,), np.int32)
+        index = np.zeros((self.num_slots,), np.int32)
+        for r in active:
+            tokens[r.slot] = r.tokens[-1]
+            index[r.slot] = r.index
+
+        t0 = time.perf_counter()
+        compiles0 = xla_compile_count()
+        data: Any = tokens[:, None]  # [slots, 1]
+        for st in self.stages:
+            data = device_put_elided(data, st.device)
+            idx = device_put_elided(index, st.device)
+            data, st.pool.slabs = st._decode_donated(
+                st.params, data, st.pool.slabs, idx
+            )
+        logits = data[:, 0]  # [slots, V]
+        nxt = _argmax_tokens(logits)
+        jax.block_until_ready(nxt)
+        now = time.perf_counter()
+        self.stats.decode_s += now - t0
+        self.stats.decode_tokens += len(active)
+        self.stats.generated_tokens += len(active)
+        self.stats.compiles += xla_compile_count() - compiles0
+
+        nxt_np = np.asarray(nxt)
+        sampled = self._sampled_rows(
+            logits, [(r.slot, r) for r in active]
+        )
+        for r in active:
+            tok = self._pick_token(r, nxt_np[r.slot],
+                                   sampled.get(r.slot))
+            r.tokens.append(tok)
+            r.index += 1
+            if r.done:
+                self._finish(r, now)
+
+    @staticmethod
+    def _sampled_rows(logits, rows) -> Dict[int, np.ndarray]:
+        """Host copies of ONLY the logits rows that temperature
+        sampling needs: ``rows`` is (row index, request) pairs; greedy
+        requests cost nothing — a full [slots, vocab] device->host pull
+        per token would tax every tick for the life of one sampling
+        request."""
+        need = [i for i, r in rows if r.temperature > 0.0]
+        if not need:
+            return {}
+        pulled = np.asarray(logits[np.asarray(need)])
+        return dict(zip(need, pulled))
+
+    def _pick_token(self, request: Request, greedy_tok, logits_row) -> int:
+        """Greedy by default; per-request temperature sampling draws
+        from a request-local stream (``fold_in(key(seed), position)``)
+        so interleaving with other requests never perturbs it."""
+        if request.temperature <= 0.0:
+            return int(greedy_tok)
+        sub = jax.random.fold_in(
+            jax.random.key(request.seed),
+            int(request.prompt.size) + len(request.tokens),
+        )
+        return int(
+            jax.random.categorical(
+                sub,
+                jnp.asarray(logits_row, jnp.float32) / request.temperature,
+            )
+        )
+
+
+__all__ = ["ServingEngine", "ServingStats"]

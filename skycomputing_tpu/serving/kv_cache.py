@@ -1,0 +1,260 @@
+"""Slot-based KV-cache slabs: the single KV-cache implementation.
+
+Every decoding path in the repo — ``models/gpt.py``'s
+``CachedGptDecoder``/``generate_cached`` and the continuous-batching
+``ServingEngine`` — stores attention keys/values in fixed-shape slabs
+``[slots, max_len, heads, head_dim]`` updated in place and reads them
+through the helpers here.  One implementation means one set of
+invariants:
+
+- **fixed shapes**: slabs are preallocated once; a request joining or
+  leaving the batch never changes a compiled program's signature (the
+  SKY002 recompile discipline applied to serving);
+- **in-place, donation-friendly updates**: :func:`update_kv_cache` is a
+  ``dynamic_update_slice`` (scalar index) or a vmapped per-row one
+  (per-slot index vector), so a caller that donates the slab argument
+  and rebinds to the output lets XLA reuse the buffer instead of
+  copying ``slots x max_len`` every token;
+- **masked staleness**: positions at or beyond a row's current index
+  hold stale garbage by design; :func:`decode_visibility` masks them
+  out of attention, so a freed slot can be handed to a new request
+  without any zeroing pass.
+
+The pool (:class:`SlotKVCachePool`) adds the host-side free-slot
+allocator per pipeline stage: slots are tickets, requests borrow one
+for their lifetime, and exhaustion is a queueing condition for the
+admission layer — never an error.
+
+No model imports here: ``models/gpt.py`` depends on this module (its
+``decode`` methods call the update/visibility helpers), not the other
+way around.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+# --------------------------------------------------------------------------
+# cache math (used inside jitted layer code)
+# --------------------------------------------------------------------------
+
+
+def update_kv_cache(k_cache, v_cache, k_new, v_new, index):
+    """Write ``k_new``/``v_new`` into the caches at per-row positions.
+
+    ``k_cache``/``v_cache``: [B, max_len, heads, head_dim] slabs;
+    ``k_new``/``v_new``: [B, Lq, heads, head_dim]; ``index``: either a
+    scalar (all rows write at the same offset — the single-request
+    decode path) or a [B] vector (each row writes at its own offset —
+    the continuous-batching path, where every slot sits at a different
+    sequence position).  Returns the updated ``(k_cache, v_cache)``.
+    Out-of-range indices clamp (``dynamic_update_slice`` semantics), so
+    an inactive slot carried through a full-slab decode step can never
+    write outside its own row.
+    """
+    k_new = k_new.astype(k_cache.dtype)
+    v_new = v_new.astype(v_cache.dtype)
+    if jnp.ndim(index) == 0:
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k_new, (0, index, 0, 0)
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v_new, (0, index, 0, 0)
+        )
+        return k_cache, v_cache
+
+    def row(cache, new, i):
+        return jax.lax.dynamic_update_slice(cache, new, (i, 0, 0))
+
+    k_cache = jax.vmap(row)(k_cache, k_new, index)
+    v_cache = jax.vmap(row)(v_cache, v_new, index)
+    return k_cache, v_cache
+
+
+def decode_visibility(index, query_len: int, max_len: int):
+    """Causal visibility mask for incremental decode: [B|1, Lq, max_len].
+
+    Query position ``q`` of row ``b`` sits at absolute position
+    ``index[b] + q`` and may attend to cache positions ``<=`` it.
+    Stale garbage beyond a row's current length is strictly in the
+    future, so this one mask both enforces causality and hides freed
+    slots' leftovers.  ``index`` scalar -> leading axis 1 (broadcasts
+    over the batch); ``index`` [B] -> per-row masks.
+    """
+    q_pos = jnp.reshape(index, (-1, 1)) + jnp.arange(
+        query_len, dtype=jnp.int32
+    )
+    k_pos = jnp.arange(max_len, dtype=jnp.int32)
+    return k_pos[None, None, :] <= q_pos[:, :, None]
+
+
+def decode_positions(index, query_len: int):
+    """Absolute positions [B|1, Lq] of the query tokens (for wpe)."""
+    return jnp.reshape(index, (-1, 1)) + jnp.arange(
+        query_len, dtype=jnp.int32
+    )
+
+
+# --------------------------------------------------------------------------
+# slab specification + allocation
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class KVCacheSpec:
+    """Shape/dtype of one attention layer's slab (minus the slot axis)."""
+
+    max_len: int
+    num_heads: int
+    head_dim: int
+    dtype: str = "float32"
+
+    def slab_shape(self, slots: int) -> Tuple[int, int, int, int]:
+        return (slots, self.max_len, self.num_heads, self.head_dim)
+
+    def slab_mb(self, slots: int) -> float:
+        """Size of the (k, v) slab PAIR in MB."""
+        n = float(slots * self.max_len * self.num_heads * self.head_dim)
+        return 2.0 * n * jnp.dtype(self.dtype).itemsize / 1024.0**2
+
+
+def kv_spec_from_config(config, max_len: int) -> KVCacheSpec:
+    """Spec from a GPT-style config (dict or object with the fields)."""
+    get = (
+        config.get if isinstance(config, dict)
+        else lambda k, d=None: getattr(config, k, d)
+    )
+    heads = int(get("num_attention_heads"))
+    hidden = int(get("hidden_size"))
+    return KVCacheSpec(
+        max_len=int(max_len),
+        num_heads=heads,
+        head_dim=hidden // heads,
+        dtype=str(get("dtype", "float32")),
+    )
+
+
+def init_layer_caches(
+    specs: Sequence[KVCacheSpec], slots: int, device=None
+) -> List[Tuple[jax.Array, jax.Array]]:
+    """Zeroed (k, v) slab pairs, one per attention layer, optionally
+    committed to ``device``.  This is the one allocation site both the
+    single-request decoder and the serving pool build on."""
+    caches = []
+    for spec in specs:
+        shape = spec.slab_shape(slots)
+        dtype = jnp.dtype(spec.dtype)
+        pair = (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+        if device is not None:
+            pair = jax.device_put(pair, device)
+        caches.append(pair)
+    return caches
+
+
+class SlotKVCachePool:
+    """Preallocated per-stage slabs + a host-side free-slot allocator.
+
+    One pool per pipeline stage: the slabs live on the stage's device
+    (allocated once, updated in place), while slot bookkeeping is pure
+    host state.  A slot id is valid across every layer of the stage —
+    request r owns row ``slot`` of all ``len(specs)`` slab pairs.
+
+    Exhaustion contract: :meth:`allocate` returns ``None`` when no slot
+    is free — the admission layer queues the request; nothing raises.
+    """
+
+    def __init__(
+        self, specs: Sequence[KVCacheSpec], slots: int, device=None
+    ):
+        if slots < 1:
+            raise ValueError(f"need at least 1 slot, got {slots}")
+        self.specs = list(specs)
+        self.num_slots = int(slots)
+        self.device = device
+        self.slabs = init_layer_caches(self.specs, self.num_slots, device)
+        # LIFO free list: reusing the hottest row keeps its pages warm
+        self._free: List[int] = list(range(self.num_slots))[::-1]
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_slots(self) -> int:
+        return self.num_slots - len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        return self.used_slots / self.num_slots
+
+    def allocate(self) -> Optional[int]:
+        """One free slot id, or None when the pool is exhausted."""
+        if not self._free:
+            return None
+        return self._free.pop()
+
+    def acquire(self, slot: int) -> None:
+        """Claim a SPECIFIC free slot — the multi-stage engine allocates
+        a slot id once and acquires the same row in every other stage's
+        pool, so one id addresses a request's cache across the whole
+        pipeline."""
+        if slot not in self._free:
+            raise ValueError(f"slot {slot} is not free")
+        self._free.remove(slot)
+
+    def release(self, slot: int) -> None:
+        if not 0 <= slot < self.num_slots:
+            raise ValueError(
+                f"slot {slot} out of range [0, {self.num_slots})"
+            )
+        if slot in self._free:
+            raise ValueError(f"slot {slot} double-released")
+        # no zeroing: stale rows are masked by decode_visibility and
+        # fully overwritten (prefix [:bucket]) on the next prefill
+        self._free.append(slot)
+
+    def total_mb(self) -> float:
+        """Preallocated slab memory of this pool in MB (all layers)."""
+        return float(
+            sum(spec.slab_mb(self.num_slots) for spec in self.specs)
+        )
+
+
+def kv_mb_per_layer(
+    model_cfg: Sequence[dict],
+    slots: int,
+    max_len: int,
+    attn_layer_type: str = "GptBlock_Attn",
+) -> List[float]:
+    """Per-layer preallocated KV-slab MB for a layer-config list.
+
+    Non-attention layers contribute 0.0; attention layers contribute
+    their (k, v) slab pair at ``slots`` x ``max_len``.  This is the
+    memory profile the serving-balanced allocator and the pre-flight
+    plan verifier add on top of the parameter/activation formula.
+    """
+    out: List[float] = []
+    for cfg in model_cfg:
+        if cfg.get("layer_type") == attn_layer_type:
+            spec = kv_spec_from_config(cfg.get("config", {}), max_len)
+            out.append(spec.slab_mb(slots))
+        else:
+            out.append(0.0)
+    return out
+
+
+__all__ = [
+    "KVCacheSpec",
+    "SlotKVCachePool",
+    "decode_positions",
+    "decode_visibility",
+    "init_layer_caches",
+    "kv_mb_per_layer",
+    "kv_spec_from_config",
+    "update_kv_cache",
+]

@@ -1,0 +1,136 @@
+"""Decode-step cost/memory profiling for serving-balanced allocation.
+
+A partition balanced on TRAINING costs is wrong for serving: training
+cost is full-sequence forward+backward (matmul-dominated, so FFN units
+outweigh attention units), while a decode step is one token against a
+``max_len``-deep KV cache (the attention units' cache read/attend work
+grows with ``max_len`` while the FFN units shrink to ``Lq=1`` matmuls).
+The memory picture flips too — activations vanish, but every attention
+layer pins a preallocated ``[slots, max_len, heads, head_dim]`` (k, v)
+slab pair for the life of the engine.
+
+:class:`DecodeModelBenchmarker` speaks the exact ``ModelBenchmarker``
+interface (``benchmark() -> (per-layer costs, per-layer mem_MB)``), so
+``Allocator.serving_allocate`` drops it into the same contiguous
+min-max solver (``optimal_allocate`` / ``skytpu_solve_classes``) that
+balances training partitions — the solver is profile-agnostic; only
+the profile changes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..builder import build_layer
+from ..dynamics.benchmarker import BaseBenchmarker, _layer_key
+from ..dynamics.estimator import Estimator
+from .kv_cache import kv_mb_per_layer, kv_spec_from_config
+
+
+class DecodeModelBenchmarker(BaseBenchmarker):
+    """Per-layer DECODE-step cost + serving memory over a model config.
+
+    ``cost[i]`` is the XLA-reported FLOPs of one decode iteration of
+    layer ``i`` at the engine's operating point (``slots`` concurrent
+    sequences, ``max_len``-deep caches) — everything the engine runs
+    per token, via ``Estimator.benchmark_decode_step``.  ``mem[i]`` is
+    the reference accounting formula for the decode activations/params
+    PLUS the layer's preallocated KV-slab MB
+    (:func:`~.kv_cache.kv_mb_per_layer` — the same formula the
+    pre-flight verifier charges, so "the allocator accepted it" and
+    "the verifier accepted it" can never disagree on slab size).
+
+    Fully static (``eval_shape`` + cost analysis — no params, no FLOPs
+    executed) and deduped by (layer-config, input-signature) like the
+    training profiler, so deep stacks profile each distinct unit once.
+    """
+
+    def __init__(
+        self,
+        model_config: List[Dict],
+        *,
+        slots: int,
+        max_len: int,
+        param_scale: int = 2,
+        attn_layer_type: str = "GptBlock_Attn",
+    ):
+        if slots < 1 or max_len < 1:
+            raise ValueError(
+                f"need positive slots/max_len, got {slots}/{max_len}"
+            )
+        self._model_config = model_config
+        self._slots = int(slots)
+        self._max_len = int(max_len)
+        self._param_scale = int(param_scale)
+        self._attn_layer_type = attn_layer_type
+        self._result: Optional[Tuple[List[float], List[float]]] = None
+
+    @property
+    def model_config(self) -> List[Dict]:
+        return self._model_config
+
+    @property
+    def operating_point(self) -> Dict[str, int]:
+        """The (slots, max_len) the profile was taken at — stamped into
+        bench provenance so a partition is never reused at a different
+        serving configuration without re-solving."""
+        return dict(slots=self._slots, max_len=self._max_len)
+
+    def benchmark(self) -> Tuple[List[float], List[float]]:
+        if self._result is not None:
+            return self._result
+        self._result = self._benchmark()
+        return self._result
+
+    def _benchmark(self) -> Tuple[List[float], List[float]]:
+        S = self._slots
+        kv_mb = kv_mb_per_layer(
+            self._model_config, S, self._max_len,
+            attn_layer_type=self._attn_layer_type,
+        )
+        index = jax.ShapeDtypeStruct((S,), np.int32)
+        # the decode wavefront: token ids enter the first layer, hidden
+        # state threads through the rest — exactly the engine's tick
+        avals: Tuple = (jax.ShapeDtypeStruct((S, 1), np.int32),)
+        cost_list: List[float] = []
+        mem_list: List[float] = []
+        cache: Dict[str, Tuple] = {}
+        for i, layer_cfg in enumerate(self._model_config):
+            key = _layer_key(layer_cfg, avals)
+            if key in cache:
+                out_aval, flops, mem = cache[key]
+            else:
+                cfg = dict(layer_cfg)
+                layer_type = cfg.pop("layer_type")
+                module = build_layer(layer_type, **cfg)
+                cache_avals = None
+                if layer_type == self._attn_layer_type:
+                    spec = kv_spec_from_config(
+                        layer_cfg.get("config", {}), self._max_len
+                    )
+                    shape = spec.slab_shape(S)
+                    dtype = jax.numpy.dtype(spec.dtype)
+                    cache_avals = (
+                        jax.ShapeDtypeStruct(shape, dtype),
+                        jax.ShapeDtypeStruct(shape, dtype),
+                    )
+                out_aval, flops, mem = Estimator.benchmark_decode_step(
+                    module, avals, cache_avals=cache_avals, index=index,
+                    param_scale=self._param_scale,
+                )
+                cache[key] = (out_aval, flops, mem)
+            cost_list.append(flops)
+            mem_list.append(mem + kv_mb[i])
+            data_out = (
+                out_aval[0] if isinstance(out_aval, tuple) else out_aval
+            )
+            avals = (
+                jax.ShapeDtypeStruct(data_out.shape, data_out.dtype),
+            )
+        return cost_list, mem_list
+
+
+__all__ = ["DecodeModelBenchmarker"]

@@ -706,6 +706,11 @@ def test_payload_schema_accepts_real_selfheal_payload():
         ({"device_scale": {"x": 2.0}}, "not a stable worker index"),
         ({"device_scale": {"0": -1.0}}, "positive finite"),
         ({"device_scale": {"0": float("nan")}}, "positive finite"),
+        # a >1e308 JSON integer must be rejected, not crash float()
+        ({"device_scale": {"0": 10 ** 400}}, "positive finite"),
+        ({"device_scale": {"0": 2.0},
+          "measured_stage_times": [10 ** 400]},
+         "measured_stage_times[0]"),
         (
             {"device_scale": {"0": 2.0},
              "measured_stage_times": [0.1, "a"]},
@@ -718,6 +723,155 @@ def test_payload_schema_rejects_malformed(payload, needle):
     problems = verify_allocation_payload(payload)
     assert problems, f"expected rejection for {payload!r}"
     assert any(needle in p for p in problems), problems
+
+
+def test_payload_schema_accepts_serving_context():
+    assert verify_allocation_payload(
+        {
+            "device_scale": {"0": 1.0},
+            "serving": {"slots": 8, "max_len": 256,
+                        "buckets": [16, 32, 64]},
+        }
+    ) == []
+
+
+@pytest.mark.parametrize(
+    "serving,needle",
+    [
+        ([8, 256], "'serving' must be an object"),
+        ({"max_len": 64}, "serving.slots must be a positive int"),
+        ({"slots": 0, "max_len": 64}, "serving.slots must be"),
+        ({"slots": 4, "max_len": True}, "serving.max_len must be"),
+        ({"slots": 4, "max_len": 64, "buckets": []},
+         "non-empty list"),
+        ({"slots": 4, "max_len": 64, "buckets": [8, "x"]},
+         "serving.buckets[1]"),
+        ({"slots": 4, "max_len": 64, "buckets": [16, 8]},
+         "strictly increasing"),
+        ({"slots": 4, "max_len": 64, "buckets": [8, 128]},
+         "exceeds serving.max_len"),
+    ],
+)
+def test_payload_schema_rejects_malformed_serving(serving, needle):
+    problems = verify_allocation_payload(
+        {"device_scale": {"0": 1.0}, "serving": serving}
+    )
+    assert problems, f"expected rejection for serving={serving!r}"
+    assert any(needle in p for p in problems), problems
+
+
+# --------------------------------------------------------------------------
+# serving-aware memory fit
+# --------------------------------------------------------------------------
+
+
+def test_serving_kv_memory_failure_names_context():
+    """A KV-slab over-budget rejection must name the serving operating
+    point (slot count, max_len, bucket) — the fix is usually fewer
+    slots or a shorter cache, not a different partition."""
+    # per-layer KV slabs of 1 MB blow a 1.5 MB budget that the bare
+    # model (~0.26 MB/slice) fits comfortably
+    report = verify_plan(
+        _model_cfg(), _wm([4, 4], mem_limit=1.5), (X,), memory="error",
+        serving=dict(slots=32, max_len=128, bucket=64,
+                     kv_mb_per_layer=[1.0] * N_UNITS),
+    )
+    assert not report.ok
+    msg = report.errors[0].message
+    assert "32 KV slots" in msg
+    assert "max_len 128" in msg
+    assert "bucket 64" in msg
+    assert "KV slabs" in msg
+    # the same plan WITHOUT the serving context passes
+    assert verify_plan(
+        _model_cfg(), _wm([4, 4], mem_limit=1.5), (X,), memory="error"
+    ).ok
+
+
+def test_serving_kv_profile_computed_from_gpt_config():
+    """Without an explicit kv_mb_per_layer the verifier derives slab
+    sizes from the model config via the engine's own formula."""
+    from skycomputing_tpu.models.gpt import GptConfig, gpt_layer_configs
+    from skycomputing_tpu.serving import kv_mb_per_layer
+
+    cfg = GptConfig(vocab_size=128, hidden_size=32, num_hidden_layers=1,
+                    num_attention_heads=2, max_position_embeddings=64,
+                    dropout_prob=0.0, dtype="float32")
+    layer_cfgs = gpt_layer_configs(cfg, deterministic=True)
+    kv = kv_mb_per_layer(layer_cfgs, 16, 64)
+    assert sum(kv) > 0
+    wm = WorkerManager()
+    wm.load_worker_pool_from_config([
+        dict(name="n0", device_config=dict(device_index=0),
+             extra_config=dict(mem_limit=sum(kv) * 0.5)),
+    ])
+    wm.worker_pool[0].model_config = layer_cfgs
+    wm.worker_pool[0].order = 1
+    ids = np.ones((4, 1), np.int32)
+    report = verify_plan(
+        layer_cfgs, wm, (ids,), memory="error",
+        serving=dict(slots=16, max_len=64),
+    )
+    assert not report.ok
+    assert any("KV slabs" in i.message for i in report.errors)
+
+
+def test_serving_kv_profile_length_mismatch_is_flagged():
+    report = verify_plan(
+        _model_cfg(), _wm([4, 4], mem_limit=1000.0), (X,),
+        memory="error",
+        serving=dict(slots=4, max_len=32, kv_mb_per_layer=[1.0, 2.0]),
+    )
+    assert not report.ok
+    assert any(
+        "does not match this model config" in i.message
+        for i in report.errors
+    )
+
+
+@pytest.mark.parametrize(
+    "serving,needle",
+    [
+        (dict(slots=4, max_len=32, kv_mb_per_layer=7),
+         "must be a list"),
+        (dict(slots=4, max_len=32, kv_mb_per_layer=["a"] * N_UNITS),
+         "must be numbers"),
+    ],
+)
+def test_serving_kv_profile_malformed_degrades_not_crashes(
+    serving, needle
+):
+    """The verifier's own no-crash contract: malformed serving input
+    becomes a PlanIssue, never a propagated exception."""
+    report = verify_plan(
+        _model_cfg(), _wm([4, 4], mem_limit=1000.0), (X,),
+        memory="error", serving=serving,
+    )
+    assert not report.ok
+    assert any(needle in i.message for i in report.errors)
+
+
+def test_serving_label_survives_junk_bucket():
+    # an over-budget diagnostic must format even with a junk bucket
+    report = verify_plan(
+        _model_cfg(), _wm([4, 4], mem_limit=0.5), (X,), memory="error",
+        serving=dict(slots=4, max_len=32, bucket="x",
+                     kv_mb_per_layer=[1.0] * N_UNITS),
+    )
+    assert not report.ok
+    assert any("bucket 'x'" in i.message for i in report.errors)
+
+
+def test_serving_context_without_shape_keys_is_flagged():
+    report = verify_plan(
+        _model_cfg(), _wm([4, 4], mem_limit=1000.0), (X,),
+        memory="error", serving=dict(bucket=16),
+    )
+    assert not report.ok
+    assert any(
+        "integer 'slots' and 'max_len'" in i.message
+        for i in report.errors
+    )
 
 
 def test_rendezvous_discards_malformed_payload(tmp_path):
