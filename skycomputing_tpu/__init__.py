@@ -38,6 +38,13 @@ from .parallel import PipelineModel, StageRuntime
 from .runner import Hook, Runner
 from .serving import Request, ServingEngine
 from .stimulator import Stimulator
+from .telemetry import (
+    MetricsRegistry,
+    Tracer,
+    disable_tracing,
+    enable_tracing,
+    get_tracer,
+)
 
 __all__ = [
     "Config",
@@ -73,5 +80,10 @@ __all__ = [
     "Request",
     "ServingEngine",
     "Stimulator",
+    "MetricsRegistry",
+    "Tracer",
+    "enable_tracing",
+    "disable_tracing",
+    "get_tracer",
     "__version__",
 ]

@@ -20,6 +20,7 @@ from __future__ import annotations
 import math
 from typing import Dict, List, Optional, Tuple
 
+from ..telemetry import trace_span
 from ..utils import Logger
 from .benchmarker import DeviceBenchmarker, ModelBenchmarker
 from .solver import solve_contiguous_minmax
@@ -102,21 +103,25 @@ class Allocator:
         ``threads`` is accepted for reference-signature parity only — the
         built-in solver is single-threaded.
         """
-        (worker_ranks, device_time, device_mem, layer_flops, layer_mem) = (
-            self._profiles()
-        )
+        with trace_span("allocator.profiles", "dynamics", "allocator"):
+            (worker_ranks, device_time, device_mem, layer_flops,
+             layer_mem) = self._profiles()
         self._logger.info(
             f"optimal_allocate: {len(layer_flops)} layers over "
             f"{len(worker_ranks)} workers; device_time={device_time}"
         )
 
-        result = solve_contiguous_minmax(
-            layer_cost=layer_flops,
-            layer_mem=layer_mem,
-            device_time=device_time,
-            device_mem=device_mem,
-            anneal_seconds=max_time,
-        )
+        with trace_span(
+            "allocator.solve", "dynamics", "allocator",
+            {"layers": len(layer_flops), "workers": len(worker_ranks)},
+        ):
+            result = solve_contiguous_minmax(
+                layer_cost=layer_flops,
+                layer_mem=layer_mem,
+                device_time=device_time,
+                device_mem=device_mem,
+                anneal_seconds=max_time,
+            )
         # exposed for callers that report provenance (bench.py stamps the
         # certified optimality gap into its JSON artifact)
         self.last_result = result
@@ -496,16 +501,20 @@ class Allocator:
         """
         if attribute == "devices":
             # validates the measurement list itself (stage_divergence)
-            self.calibrate_device_speeds(
-                measured_stage_times, damping=damping
-            )
+            with trace_span("allocator.calibrate", "dynamics", "allocator",
+                            {"attribute": attribute}):
+                self.calibrate_device_speeds(
+                    measured_stage_times, damping=damping
+                )
         elif attribute == "layers":
             workers = self._ordered_stage_workers(measured_stage_times)
-            self.calibrate_costs(
-                [len(w.model_config) for w in workers],
-                measured_stage_times,
-                damping=damping,
-            )
+            with trace_span("allocator.calibrate", "dynamics", "allocator",
+                            {"attribute": attribute}):
+                self.calibrate_costs(
+                    [len(w.model_config) for w in workers],
+                    measured_stage_times,
+                    damping=damping,
+                )
         else:
             raise ValueError(
                 f"unknown attribute {attribute!r}; use 'layers' or 'devices'"

@@ -31,6 +31,7 @@ import numpy as np
 from ..builder import build_layer, build_layer_stack
 from ..dataset import BaseGenerator
 from ..stimulator import Stimulator
+from ..telemetry import trace_span
 from ..utils import generate_worker_name
 from .estimator import Estimator
 from .worker_manager import WorkerManager
@@ -108,23 +109,9 @@ class DeviceBenchmarker(BaseBenchmarker):
         if device in self._device_time_cache:
             elapsed = self._device_time_cache[device]
         else:
-            stack = build_layer_stack(self._model_config)
-            data = data if isinstance(data, tuple) else (data,)
-            if self._dtype is not None:
-                data = tuple(np.asarray(d).astype(self._dtype) for d in data)
-
-            params = stack.init(jax.random.key(0), *data)
-            params = jax.device_put(params, device)
-
-            def fwd(p, *xs):
-                return stack.apply(p, *xs)
-
-            elapsed = Estimator.benchmark_speed(
-                fwd,
-                [params, *data],
-                device=device,
-                iterations=self._iterations,
-            )
+            with trace_span("bench.device", "dynamics", "benchmark",
+                            {"device": str(device)}):
+                elapsed = self._measure_device(device, data)
             self._device_time_cache[device] = elapsed
 
         mem_limit = worker.extra_config.get("mem_limit", -1)
@@ -133,6 +120,26 @@ class DeviceBenchmarker(BaseBenchmarker):
         else:
             avai_mem = device_available_memory_mb(device)
         return elapsed, avai_mem
+
+    def _measure_device(self, device, data) -> float:
+        """One timed proxy-model run on ``device`` (the cache-miss path)."""
+        stack = build_layer_stack(self._model_config)
+        data = data if isinstance(data, tuple) else (data,)
+        if self._dtype is not None:
+            data = tuple(np.asarray(d).astype(self._dtype) for d in data)
+
+        params = stack.init(jax.random.key(0), *data)
+        params = jax.device_put(params, device)
+
+        def fwd(p, *xs):
+            return stack.apply(p, *xs)
+
+        return Estimator.benchmark_speed(
+            fwd,
+            [params, *data],
+            device=device,
+            iterations=self._iterations,
+        )
 
     def benchmark(self) -> Dict[str, Dict[str, float]]:
         results: Dict[str, Dict[str, float]] = {}
@@ -220,7 +227,11 @@ class ModelBenchmarker(BaseBenchmarker):
         """
         if self._result is not None:
             return self._result
-        self._result = self._benchmark()
+        with trace_span(
+            "bench.model", "dynamics", "benchmark",
+            {"layers": len(self._model_config), "timed": self._timed},
+        ):
+            self._result = self._benchmark()
         return self._result
 
     def _benchmark(self) -> Tuple[List[float], List[float]]:

@@ -45,6 +45,7 @@ import optax
 from ..builder import as_tuple, build_layer_stack
 from ..dynamics.parameter_server import ParameterServer
 from ..dynamics.worker_manager import WorkerManager
+from ..telemetry import get_tracer
 
 
 # --- hot-path switches & counters -------------------------------------------
@@ -120,6 +121,16 @@ def _ensure_compile_listener() -> None:
         def _on_duration(name: str, _secs: float, **_kw) -> None:
             if name == "/jax/core/compile/backend_compile_duration":
                 _XLA_COMPILES[0] += 1
+                tracer = get_tracer()
+                if tracer is not None:
+                    # the probe reports AFTER the compile finished: back
+                    # the start off the duration so the span sits where
+                    # the compile actually ran on the timeline
+                    end = tracer.now()
+                    tracer.complete(
+                        "xla_compile", tracer.lane("xla", "compile"),
+                        max(end - _secs * 1e6, 0.0), dur_us=_secs * 1e6,
+                    )
 
         monitoring.register_event_duration_secs_listener(_on_duration)
     except Exception:  # pragma: no cover - monitoring API moved/absent
@@ -162,6 +173,12 @@ def device_put_elided(tree, device):
     if all(resident):
         # the steady-state fast path: no api call, no tree rebuild
         _TRANSFER_STATS["elided"] += len(leaves)
+        tracer = get_tracer()
+        if tracer is not None:
+            tracer.instant(
+                "transfer_elided", tracer.lane("transfers", str(device)),
+                {"leaves": len(leaves)},
+            )
         return tree
     to_move = [x for x, r in zip(leaves, resident) if not r]
     # ONE batched put for everything that actually moves: per-call fixed
@@ -170,6 +187,12 @@ def device_put_elided(tree, device):
     moved = iter(jax.device_put(to_move, device))
     _TRANSFER_STATS["copies"] += len(to_move)
     _TRANSFER_STATS["elided"] += len(leaves) - len(to_move)
+    tracer = get_tracer()
+    if tracer is not None:
+        tracer.instant(
+            "transfer", tracer.lane("transfers", str(device)),
+            {"moved": len(to_move), "elided": len(leaves) - len(to_move)},
+        )
     out = [x if r else next(moved) for x, r in zip(leaves, resident)]
     return jax.tree_util.tree_unflatten(treedef, out)
 
@@ -360,6 +383,10 @@ class StageRuntime:
         self.stage_index = stage_index
         self.device = device
         self.num_layers = len(layer_cfgs)
+        # trace-lane name: one Perfetto process row per (stage, device);
+        # tools/trace_report.py keys stage utilization on the "stage N"
+        # prefix, so keep it first
+        self.lane_name = f"stage {stage_index} [{device}]"
         self.slowdown = float(slowdown)
         self._differentiable_inputs = differentiable_inputs
         # canonical structure key: stages sharing it run the same compiled
@@ -477,6 +504,17 @@ class PipelineStats:
     transfers: int = 0
     transfers_elided: int = 0
     compiles: int = 0
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able field dict — the ``ServingStats.snapshot()`` twin.
+
+        Consumers (``MetricsHook``, ``MetricsRegistry``) iterate this
+        instead of hand-copying field names, so a field added here
+        reaches every metrics surface without further wiring.
+        """
+        import dataclasses
+
+        return dataclasses.asdict(self)
 
 
 class PipelineModel:
@@ -676,6 +714,20 @@ class PipelineModel:
         )
         return total_loss
 
+    def _trace_lanes(self):
+        """(tracer, per-stage lane list) — (None, None) when disabled.
+
+        Hoisted out of the issue loops: one accessor call and S lane
+        lookups per compute_gradients call, zero per microbatch.
+        """
+        tracer = get_tracer()
+        if tracer is None:
+            return None, None
+        return tracer, [
+            tracer.lane(stage.lane_name, "dispatch")
+            for stage in self.stages
+        ]
+
     @property
     def _interleaved(self) -> bool:
         """True when gradients come from the fused-fwd/bwd 1F1B path (the
@@ -724,6 +776,7 @@ class PipelineModel:
         micro_data = _split_microbatches(as_tuple(data), M)
         micro_labels = _split_microbatches(labels, M)
         scale = 1.0 / M
+        tracer, lanes = self._trace_lanes()
 
         t0 = time.perf_counter()
 
@@ -749,7 +802,12 @@ class PipelineModel:
             for k, stage in enumerate(self.stages):
                 acts = device_put_elided(acts, stage.device)
                 stage_inputs[k].append(acts)
-                acts = stage.forward_placed(acts, rngs[m][k])
+                if tracer is None:
+                    acts = stage.forward_placed(acts, rngs[m][k])
+                else:
+                    span0 = tracer.now()
+                    acts = stage.forward_placed(acts, rngs[m][k])
+                    tracer.complete("fwd", lanes[k], span0, {"mb": m})
             final_acts_per_mb.append(acts)
         dispatch_s = time.perf_counter() - t0
         if block:
@@ -769,7 +827,16 @@ class PipelineModel:
             dy: Optional[Tuple] = (dlogits,) + self._zero_tail(final_acts)
             for k in reversed(range(len(self.stages))):
                 stage = self.stages[k]
-                grads, dx = stage.backward(stage_inputs[k][m], rngs[m][k], dy)
+                if tracer is None:
+                    grads, dx = stage.backward(
+                        stage_inputs[k][m], rngs[m][k], dy
+                    )
+                else:
+                    span0 = tracer.now()
+                    grads, dx = stage.backward(
+                        stage_inputs[k][m], rngs[m][k], dy
+                    )
+                    tracer.complete("bwd", lanes[k], span0, {"mb": m})
                 grad_totals[k] = stage.accumulate(grad_totals[k], grads)
                 dy = dx
         dispatch_s += time.perf_counter() - t1
@@ -781,8 +848,14 @@ class PipelineModel:
 
     def apply_gradients(self, grad_totals) -> None:
         """Apply per-stage gradient totals with each stage's optimizer."""
+        tracer, lanes = self._trace_lanes()
         for k, stage in enumerate(self.stages):
-            stage.apply_gradients(grad_totals[k])
+            if tracer is None:
+                stage.apply_gradients(grad_totals[k])
+            else:
+                span0 = tracer.now()
+                stage.apply_gradients(grad_totals[k])
+                tracer.complete("update", lanes[k], span0)
 
     def _compute_gradients_1f1b(self, data, labels, rng, block: bool = True):
         """One-forward-one-backward schedule: issue each microbatch's
@@ -802,6 +875,7 @@ class PipelineModel:
         micro_data = _split_microbatches(as_tuple(data), M)
         micro_labels = _split_microbatches(labels, M)
         scale = 1.0 / M
+        tracer, lanes = self._trace_lanes()
 
         rngs = _step_rngs(rng, M, S)
 
@@ -848,7 +922,12 @@ class PipelineModel:
             )
             acts = device_put_elided(acts, stage.device)
             stage_inputs[k][m] = acts
-            out = stage.forward_placed(acts, rngs[m][k])
+            if tracer is None:
+                out = stage.forward_placed(acts, rngs[m][k])
+            else:
+                span0 = tracer.now()
+                out = stage.forward_placed(acts, rngs[m][k])
+                tracer.complete("fwd", lanes[k], span0, {"mb": m})
             if k < S - 1:
                 stage_outputs[k][m] = out
             else:
@@ -866,9 +945,16 @@ class PipelineModel:
             m = bwd_next[k]
             stage = self.stages[k]
             dy = dys[k].pop(m) if k == S - 1 else dys[k + 1].pop(m)
-            grads, dx = stage.backward(
-                stage_inputs[k].pop(m), rngs[m][k], dy
-            )
+            if tracer is None:
+                grads, dx = stage.backward(
+                    stage_inputs[k].pop(m), rngs[m][k], dy
+                )
+            else:
+                span0 = tracer.now()
+                grads, dx = stage.backward(
+                    stage_inputs[k].pop(m), rngs[m][k], dy
+                )
+                tracer.complete("bwd", lanes[k], span0, {"mb": m})
             grad_totals[k] = stage.accumulate(grad_totals[k], grads)
             if k > 0:
                 dys[k][m] = dx

@@ -8,6 +8,7 @@ from .hooks_collection import (
     NanGuardHook,
     SelfHealHook,
     StopHook,
+    TraceHook,
     WatchdogHook,
 )
 from .runner import Runner
@@ -23,5 +24,6 @@ __all__ = [
     "NanGuardHook",
     "SelfHealHook",
     "StopHook",
+    "TraceHook",
     "WatchdogHook",
 ]

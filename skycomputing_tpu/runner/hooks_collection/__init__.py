@@ -5,6 +5,7 @@ from .metrics_hook import MetricsHook
 from .selfheal_hook import SelfHealHook
 from .stop_hook import StopHook
 from .timer_hook import DistributedTimerHelperHook
+from .trace_hook import TraceHook
 from .watchdog_hook import NanGuardHook, WatchdogHook
 
 __all__ = [
@@ -16,5 +17,6 @@ __all__ = [
     "SelfHealHook",
     "StopHook",
     "DistributedTimerHelperHook",
+    "TraceHook",
     "WatchdogHook",
 ]

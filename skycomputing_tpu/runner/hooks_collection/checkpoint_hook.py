@@ -19,6 +19,7 @@ from typing import Optional
 import numpy as np
 
 from ...registry import HOOKS
+from ...telemetry import trace_span
 from ..hooks import Hook
 
 
@@ -124,6 +125,10 @@ class CheckpointHook(Hook):
         runner.parameter_server.wait_for_saves()
 
     def _save(self, runner, tag: str) -> None:
+        with trace_span("checkpoint", "runner", "lifecycle", {"tag": tag}):
+            self._save_traced(runner, tag)
+
+    def _save_traced(self, runner, tag: str) -> None:
         os.makedirs(self._save_path, exist_ok=True)
         runner.model.sync_to_parameter_server()
         if self._format == "orbax":

@@ -3,14 +3,24 @@
 The reference's observability is free-text log lines; machine-readable
 per-iteration records (loss, phase times, throughput) are what dashboards
 and regression tooling actually consume.
+
+The file is append-mode (restarts accumulate), so every run opens with a
+``run_start`` header record carrying a fresh ``run_id`` that is threaded
+into every subsequent record — two interleaved or restarted runs are
+separable by grouping on it instead of guessing at timestamp gaps.  The
+per-iteration payload is ``PipelineStats.snapshot()`` verbatim: a field
+added to the stats dataclass reaches the metrics file with no hook edit
+(the hand-maintained field list this hook used to carry silently dropped
+new fields).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import time
-from typing import Optional
+import uuid
 
 from ...registry import HOOKS
 from ..hooks import Hook
@@ -26,34 +36,52 @@ class MetricsHook(Hook):
         self._flush_every = flush_every
         self._fh = None
         self._pending = 0
+        self._run_id = None
+
+    @staticmethod
+    def _config_hash(runner) -> str:
+        """Stable digest of the run's shape: same allocation + loop
+        bounds -> same hash, so a reader can tell a restart of the SAME
+        run from a differently-configured one sharing the file."""
+        signature = getattr(runner.model, "partition_signature", None)
+        ident = {
+            "partition": signature() if callable(signature) else None,
+            "max_epochs": runner.max_epochs,
+            "max_iters": runner.max_iters,
+        }
+        blob = json.dumps(ident, sort_keys=True, default=str)
+        return hashlib.sha1(blob.encode()).hexdigest()[:12]
 
     def before_run(self, runner):
         self._fh = open(self._path, "a")
+        self._run_id = uuid.uuid4().hex[:12]
+        header = {
+            "event": "run_start",
+            "run_id": self._run_id,
+            "ts": time.time(),
+            "world_size": runner.worker_manager.size,
+            "config_hash": self._config_hash(runner),
+            "epoch": runner.epoch,
+            "iter": runner.iter,
+        }
+        self._fh.write(json.dumps(header) + "\n")
+        # the header must hit disk even if the run dies in iteration 1:
+        # an unflushed header plus a flushed crash log reads as "no run"
+        self._fh.flush()
+        self._pending = 0
 
     def after_iter(self, runner):
         if self._fh is None:  # pragma: no cover - hook misuse
             return
-        stats = runner.model.stats
         record = {
             "ts": time.time(),
+            "run_id": self._run_id,
             "epoch": runner.epoch,
             "iter": runner.iter,
-            "loss": stats.loss,
-            "forward_s": stats.forward_s,
-            "backward_s": stats.backward_s,
-            "step_s": stats.step_s,
-            # under 1f1b forward_s holds the fused fwd+bwd time
-            "interleaved": stats.interleaved,
-            # host-overhead split: time spent issuing work vs blocked on
-            # devices, device_put copies performed vs elided, and XLA
-            # backend compiles this step (nonzero after step 1 means a
-            # recompile regression — exactly what this record is for)
-            "dispatch_s": stats.dispatch_s,
-            "compute_wait_s": stats.compute_wait_s,
-            "transfers": stats.transfers,
-            "transfers_elided": stats.transfers_elided,
-            "compiles": stats.compiles,
         }
+        # the whole stats surface, schema-free: PipelineStats.snapshot()
+        # mirrors ServingStats.snapshot(), one contract for both engines
+        record.update(runner.model.stats.snapshot())
         self._fh.write(json.dumps(record) + "\n")
         self._pending += 1
         if self._pending >= self._flush_every:
@@ -61,6 +89,8 @@ class MetricsHook(Hook):
             self._pending = 0
 
     def after_run(self, runner):
+        # fires from the Runner's finally block, so the file is flushed
+        # and closed even when training raises mid-epoch
         if self._fh is not None:
             self._fh.flush()
             self._fh.close()

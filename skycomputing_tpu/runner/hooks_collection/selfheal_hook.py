@@ -42,6 +42,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ...registry import HOOKS
+from ...telemetry import get_tracer, trace_span
 from ..hooks import Hook
 
 
@@ -106,6 +107,7 @@ class SelfHealHook(Hook):
         self.heals = 0
         self.events: List[Dict[str, Any]] = []
         self._disarmed = False
+        self._arc_id = 0  # trace async-arc id, one per heal attempt
         self._reset_telemetry()
 
     # --- telemetry ----------------------------------------------------------
@@ -195,21 +197,42 @@ class SelfHealHook(Hook):
         )
 
     # --- healing ------------------------------------------------------------
+    def _arc_end(self, runner, outcome: str) -> None:
+        """Close this heal attempt's async trace arc (opened in _heal)."""
+        tracer = get_tracer()
+        if tracer is not None:
+            tracer.async_end(
+                "self_heal", tracer.lane("selfheal", "arc"),
+                self._arc_id, {"outcome": outcome, "iter": runner.iter},
+            )
+
     def _heal(self, runner, window_mean: float) -> None:
         runner.logger.info(
             f"SelfHealHook: sustained degradation at iter {runner.iter} "
             f"(window mean {window_mean:.4f}s, EWMA {self._ewma:.4f}s, "
             f"baseline {self._baseline:.4f}s); measuring stages"
         )
+        # the detect -> measure -> re-allocate -> rebuild arc spans many
+        # iterations of other work, so it is an ASYNC trace arc: opened
+        # here at detection, closed by _arc_end on every exit path
+        self._arc_id += 1
+        tracer = get_tracer()
+        if tracer is not None:
+            tracer.async_begin(
+                "self_heal", tracer.lane("selfheal", "arc"), self._arc_id,
+                {"iter": runner.iter, "window_mean_s": window_mean},
+            )
         if runner.current_batch is None:
             self._record(runner, "no_probe_batch")
+            self._arc_end(runner, "no_probe_batch")
             return
         data, _ = runner.current_batch
-        measured = runner.model.measure_stage_times(
-            data,
-            repeats=self._measure_repeats,
-            inner_iters=self._measure_inner,
-        )
+        with trace_span("selfheal.measure", "selfheal", "phases"):
+            measured = runner.model.measure_stage_times(
+                data,
+                repeats=self._measure_repeats,
+                inner_iters=self._measure_inner,
+            )
         divergence = self._allocator.stage_divergence(measured)
         worst = max(divergence.values()) if divergence else 1.0
         if worst < self._confirm_threshold:
@@ -222,6 +245,7 @@ class SelfHealHook(Hook):
             )
             self._record(runner, "stand_down", divergence=divergence,
                          measured=list(measured))
+            self._arc_end(runner, "stand_down")
             self._reset_telemetry()
             return
 
@@ -245,7 +269,8 @@ class SelfHealHook(Hook):
             max_time=self._solver_time_s,
             attribute="devices",
         )
-        runner.model.rebuild()
+        with trace_span("selfheal.rebuild", "selfheal", "phases"):
+            runner.model.rebuild()
         # the world changed: re-arm the runner's pre-flight so the NEW
         # plan is abstractly verified before its first train step — a
         # broken re-allocation must surface as a diagnostic, not as a
@@ -264,6 +289,7 @@ class SelfHealHook(Hook):
             f"{runner.model.partition_signature()} (divergence "
             f"{ {k: round(v, 2) for k, v in divergence.items()} })"
         )
+        self._arc_end(runner, "healed")
         self._reset_telemetry()
 
     def _exit_for_realloc(self, runner, measured, divergence) -> None:
@@ -306,6 +332,7 @@ class SelfHealHook(Hook):
             f"SelfHealHook: exiting rc={REALLOC_RC} for supervised "
             f"re-allocation"
         )
+        self._arc_end(runner, "heal_exit")
         # SystemExit is not an Exception: Runner's abort detection leaves
         # ``aborted`` False (the params are fine — we just snapshotted),
         # after_run hooks still flush, and the supervisor sees REALLOC_RC

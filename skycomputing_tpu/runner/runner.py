@@ -19,6 +19,7 @@ import jax
 from ..dynamics import ParameterServer, WorkerManager
 from ..ops import build_loss
 from ..parallel import PipelineModel
+from ..telemetry import MetricsRegistry, trace_span
 from ..utils import (
     DistributedTimer,
     Logger,
@@ -70,6 +71,12 @@ class Runner:
         self._logger = Logger(**(logging_cfg or {}))
         self._timer = DistributedTimer(**(timer_cfg or {}))
         self.phase_timer = PhaseTimer()
+        # unified metrics surface: hooks and external pollers read the
+        # pipeline's per-step counters through one snapshot() contract
+        # (the callable form survives the model rebinding `stats` to a
+        # fresh PipelineStats every step)
+        self.metrics = MetricsRegistry()
+        self.metrics.register("pipeline", lambda: self.model.stats.snapshot())
         self.data_loader = None
         # the in-flight (data, labels) pair, stashed for hooks that need a
         # representative batch (SelfHealHook probes stage times with it)
@@ -178,7 +185,8 @@ class Runner:
             )
             self._preflight_done = True
             return
-        report = verify_pipeline(self.model, data)
+        with trace_span("preflight", "runner", "lifecycle"):
+            report = verify_pipeline(self.model, data)
         for issue in report.issues:
             self._logger.info(f"pre-flight: {issue.format()}")
         # done only on success: a rejected plan must be re-verified on a

@@ -58,6 +58,7 @@ from ..parallel.pipeline import (
     device_put_elided,
     xla_compile_count,
 )
+from ..telemetry import MetricsRegistry, get_tracer
 from .batcher import (
     AdmissionQueue,
     FINISHED,
@@ -162,6 +163,9 @@ class _ServingStage:
         self.stage_index = stage_index
         self.modules = list(modules)
         self.device = device
+        # trace-lane name, same convention as StageRuntime.lane_name so
+        # serving and training timelines read identically in Perfetto
+        self.lane_name = f"stage {stage_index} [{device}]"
         self.params: List[Any] = jax.device_put(list(params), device)
         specs = [
             kv_spec_from_config(
@@ -280,6 +284,10 @@ class ServingEngine:
         # the slowest — the failure mode continuous batching removes
         self.static_batching = bool(static_batching)
         self.stats = ServingStats()
+        # same snapshot() contract as the training runner's registry, so
+        # one poller reads either subsystem identically
+        self.metrics = MetricsRegistry()
+        self.metrics.register("serving", lambda: self.stats.snapshot())
         self._running: Dict[int, Request] = {}  # request_id -> Request
         self._finished: List[Request] = []
 
@@ -404,6 +412,12 @@ class ServingEngine:
         request.slot = None
         request.preemptions += 1
         self.stats.preemptions += 1
+        tracer = get_tracer()
+        if tracer is not None:
+            tracer.instant(
+                "preempt", tracer.lane("serving", "engine"),
+                {"request": request_id},
+            )
         self._queue.submit(request)
         self.stats.queue_depth = self._queue.depth
         return request
@@ -434,6 +448,12 @@ class ServingEngine:
         scheduling."""
         if self._queue.depth > 0 and self.free_slots == 0:
             self.stats.queue_stalls += 1
+            tracer = get_tracer()
+            if tracer is not None:
+                tracer.instant(
+                    "queue_stall", tracer.lane("serving", "engine"),
+                    {"queued": self._queue.depth},
+                )
         self._admit()
         self._decode_tick()
         self.stats.iterations += 1
@@ -493,21 +513,43 @@ class ServingEngine:
             r.slot = slot
             slot_ids[i] = slot
 
+        tracer = get_tracer()
+        span0 = tracer.now() if tracer is not None else 0.0
         t0 = time.perf_counter()
         compiles0 = xla_compile_count()
         data: Any = ids
         for st in self.stages:
             data = device_put_elided(data, st.device)
             sids = device_put_elided(slot_ids, st.device)
-            data, st.pool.slabs = st._prefill_donated(
-                st.params, data, st.pool.slabs, sids
-            )
+            if tracer is None:
+                data, st.pool.slabs = st._prefill_donated(
+                    st.params, data, st.pool.slabs, sids
+                )
+            else:
+                stage0 = tracer.now()
+                data, st.pool.slabs = st._prefill_donated(
+                    st.params, data, st.pool.slabs, sids
+                )
+                tracer.complete(
+                    "prefill", tracer.lane(st.lane_name, "dispatch"),
+                    stage0, {"bucket": bucket},
+                )
         pos = device_put_elided(lengths - 1, self._last_device)
         logits = _gather_last(data, pos)  # [rows, V]
         tokens = _argmax_tokens(logits)
         jax.block_until_ready(tokens)
         now = time.perf_counter()
         self.stats.prefill_s += now - t0
+        if tracer is not None:
+            tracer.complete(
+                "prefill", tracer.lane("serving", "engine"), span0,
+                {"bucket": bucket, "wave": len(wave)},
+            )
+            for r in wave:
+                tracer.instant(
+                    "admit", tracer.lane("serving", "engine"),
+                    {"request": r.request_id, "slot": r.slot},
+                )
         self.stats.prefill_waves += 1
         self.stats.prefill_tokens += int(lengths[: len(wave)].sum())
         # per-call delta, not a process-global diff: foreign jit work in
@@ -540,20 +582,36 @@ class ServingEngine:
             tokens[r.slot] = r.tokens[-1]
             index[r.slot] = r.index
 
+        tracer = get_tracer()
+        span0 = tracer.now() if tracer is not None else 0.0
         t0 = time.perf_counter()
         compiles0 = xla_compile_count()
         data: Any = tokens[:, None]  # [slots, 1]
         for st in self.stages:
             data = device_put_elided(data, st.device)
             idx = device_put_elided(index, st.device)
-            data, st.pool.slabs = st._decode_donated(
-                st.params, data, st.pool.slabs, idx
-            )
+            if tracer is None:
+                data, st.pool.slabs = st._decode_donated(
+                    st.params, data, st.pool.slabs, idx
+                )
+            else:
+                stage0 = tracer.now()
+                data, st.pool.slabs = st._decode_donated(
+                    st.params, data, st.pool.slabs, idx
+                )
+                tracer.complete(
+                    "decode", tracer.lane(st.lane_name, "dispatch"), stage0
+                )
         logits = data[:, 0]  # [slots, V]
         nxt = _argmax_tokens(logits)
         jax.block_until_ready(nxt)
         now = time.perf_counter()
         self.stats.decode_s += now - t0
+        if tracer is not None:
+            tracer.complete(
+                "decode", tracer.lane("serving", "engine"), span0,
+                {"active": len(active)},
+            )
         self.stats.decode_tokens += len(active)
         self.stats.generated_tokens += len(active)
         self.stats.compiles += xla_compile_count() - compiles0

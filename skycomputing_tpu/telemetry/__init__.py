@@ -1,0 +1,24 @@
+"""Unified telemetry: span tracer, Chrome-trace export, metrics registry.
+
+Pure stdlib — importable from every layer (parallel, runner, dynamics,
+serving, tools) without pulling jax, and cheap enough to leave wired in
+production code paths permanently (disabled tracing is a ``None`` check).
+"""
+
+from .metrics import MetricsRegistry
+from .tracer import (
+    Tracer,
+    disable_tracing,
+    enable_tracing,
+    get_tracer,
+    trace_span,
+)
+
+__all__ = [
+    "MetricsRegistry",
+    "Tracer",
+    "disable_tracing",
+    "enable_tracing",
+    "get_tracer",
+    "trace_span",
+]

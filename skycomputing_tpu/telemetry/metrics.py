@@ -1,0 +1,96 @@
+"""MetricsRegistry: one ``snapshot()`` contract over every stats surface.
+
+Before this module, each subsystem grew its own counter schema and its
+own consumer: ``PipelineStats`` fields were hand-copied into
+``MetricsHook``'s record dict (a field added to the stats silently never
+reached the metrics file), and ``ServingStats`` maintained a parallel
+``snapshot()`` of its own.  The registry unifies them behind a single
+contract:
+
+- a **source** is anything exposing ``snapshot() -> dict`` (both stats
+  dataclasses now do) or a zero-argument callable returning a dict —
+  the callable form is what lets a consumer register "the pipeline's
+  stats" once even though ``PipelineModel`` rebinds ``self.stats`` to a
+  fresh object every step;
+- :meth:`MetricsRegistry.snapshot` returns ``{source_name: {field:
+  value}}`` — the nested form dashboards consume;
+- :meth:`MetricsRegistry.flat` returns ``{"source.field": value}`` —
+  the form counter files and Perfetto counter tracks consume.
+
+``Runner`` registers its pipeline stats under ``"pipeline"`` and
+``ServingEngine`` its SLO surface under ``"serving"``, so one
+``registry.snapshot()`` call reads the whole system regardless of which
+subsystems are live in the process.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Union
+
+Source = Union[Callable[[], Dict[str, Any]], Any]
+
+
+class MetricsRegistry:
+    """Named metric sources behind one ``snapshot()`` contract."""
+
+    def __init__(self) -> None:
+        self._sources: Dict[str, Callable[[], Dict[str, Any]]] = {}
+
+    def register(self, name: str, source: Source) -> None:
+        """Register a source under ``name``.
+
+        ``source`` is either an object with a ``snapshot()`` method or a
+        zero-arg callable returning a dict.  Duplicate names are an
+        error: two subsystems silently shadowing each other's counters
+        is exactly the ambiguity this registry exists to remove.
+        """
+        if name in self._sources:
+            raise ValueError(f"metric source {name!r} already registered")
+        snap = getattr(source, "snapshot", None)
+        if callable(snap):
+            self._sources[name] = snap
+        elif callable(source):
+            self._sources[name] = source
+        else:
+            raise TypeError(
+                f"metric source {name!r} must expose snapshot() or be "
+                f"callable, got {type(source).__name__}"
+            )
+
+    def unregister(self, name: str) -> None:
+        self._sources.pop(name, None)
+
+    def names(self) -> List[str]:
+        return sorted(self._sources)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._sources
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """``{source_name: snapshot_dict}`` over every registered source.
+
+        A source returning a non-dict is a contract violation surfaced
+        immediately (a silently-skipped source would read as "no
+        metrics" downstream).
+        """
+        out: Dict[str, Dict[str, Any]] = {}
+        for name, snap in self._sources.items():
+            value = snap()
+            if not isinstance(value, dict):
+                raise TypeError(
+                    f"metric source {name!r} snapshot() returned "
+                    f"{type(value).__name__}, expected dict"
+                )
+            out[name] = value
+        return out
+
+    def flat(self, sep: str = ".") -> Dict[str, Any]:
+        """One flat ``{"source.field": value}`` dict (counter-file form)."""
+        out: Dict[str, Any] = {}
+        for name, record in self.snapshot().items():
+            for key, value in record.items():
+                out[f"{name}{sep}{key}"] = value
+        return out
+
+
+__all__ = ["MetricsRegistry"]

@@ -398,5 +398,9 @@ def test_eval_and_metrics_hooks(devices, tmp_path):
 
     with open(metrics_path) as fh:
         records = [json.loads(line) for line in fh]
-    assert len(records) == 16  # train iters only — eval iters not logged
-    assert all("loss" in r and "forward_s" in r for r in records)
+    # one run_start header, then train iters only — eval iters not logged
+    assert records[0]["event"] == "run_start"
+    rows = records[1:]
+    assert len(rows) == 16
+    assert all("loss" in r and "forward_s" in r for r in rows)
+    assert all(r["run_id"] == records[0]["run_id"] for r in rows)

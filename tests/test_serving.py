@@ -188,6 +188,28 @@ def test_zero_steady_state_recompiles_after_bucket_warmup(gpt):
         np.testing.assert_array_equal(
             outputs[r.request_id], reference(fwd, r)
         )
+    # the pin must also hold WITH tracing on: instrumentation (telemetry
+    # spans around prefill/decode) cannot perturb jit identity, and the
+    # traced wave stays token-identical
+    from skycomputing_tpu import telemetry
+
+    # fresh snapshot: the reference() identity loop above jit-compiles
+    # the one-shot fwd, which is NOT engine work — counting from `warm`
+    # would make this assertion order-dependent across test selection
+    warm_traced = xla_compile_count()
+    telemetry.enable_tracing()
+    try:
+        traced_wave = mixed_requests(rng, [(5, 4), (13, 3)])
+        traced_out = engine.run(traced_wave)
+        assert xla_compile_count() == warm_traced, (
+            "tracing-enabled serving step recompiled"
+        )
+    finally:
+        telemetry.disable_tracing()
+    for r in traced_wave:
+        np.testing.assert_array_equal(
+            traced_out[r.request_id], reference(fwd, r)
+        )
 
 
 # --------------------------------------------------------------------------

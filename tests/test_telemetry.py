@@ -1,0 +1,518 @@
+"""Unified-telemetry contracts: tracer, Chrome-trace export, TraceHook,
+trace_report analysis + regression gate, metrics unification, and the
+Logger/MetricsHook satellites.
+
+The tracer's promises are structural (strict Chrome-trace JSON, spans
+that nest and never go negative under hostile clocks, a disabled path
+that allocates nothing) and economic (traced steps must not recompile,
+per-event cost small enough that a traced step stays <1% slower).  Both
+kinds are pinned here, in tier-1 time.
+"""
+
+import json
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from skycomputing_tpu import telemetry
+from skycomputing_tpu.telemetry import MetricsRegistry, Tracer
+from skycomputing_tpu.telemetry.tracer import _NULL_SPAN
+from tests.test_pipeline import build_pipeline
+from tools.trace_report import (
+    analyze,
+    baseline_targets,
+    check_regression,
+    load_events,
+)
+from tools.trace_report import main as report_main
+
+pytestmark = pytest.mark.trace
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_tracer():
+    """Every test starts and ends with tracing disabled (process-global)."""
+    telemetry.disable_tracing()
+    yield
+    telemetry.disable_tracing()
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# --------------------------------------------------------------------------
+# tracer core
+# --------------------------------------------------------------------------
+
+
+def test_chrome_trace_schema_is_strict_json():
+    clock = FakeClock()
+    tracer = Tracer(capacity=128, clock=clock)
+    lane = tracer.lane("stage 0 [cpu]", "dispatch")
+    with tracer.span("fwd", lane, {"mb": 0}):
+        clock.t += 0.001
+    tracer.instant("transfer", tracer.lane("transfers", "cpu"),
+                   {"moved": 2})
+    tracer.counter("queue", tracer.lane("serving", "engine"), {"depth": 3})
+    arc = tracer.lane("selfheal", "arc")
+    tracer.async_begin("self_heal", arc, 1, {"iter": 5})
+    clock.t += 0.002
+    tracer.async_end("self_heal", arc, 1)
+
+    blob = json.dumps(tracer.to_chrome())
+    doc = json.loads(blob)  # strict JSON round-trip
+    events = doc["traceEvents"]
+    assert events, "no events exported"
+    for ev in events:
+        for key in ("ph", "ts", "pid", "tid", "name"):
+            assert key in ev, f"event missing {key}: {ev}"
+    # complete events carry dur, instants their scope, asyncs an id
+    phs = {ev["ph"] for ev in events}
+    assert {"M", "X", "i", "C", "b", "e"} <= phs
+    for ev in events:
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0
+        if ev["ph"] == "i":
+            assert ev["s"] == "t"
+        if ev["ph"] in ("b", "e"):
+            assert ev["id"] == 1
+    # lane metadata names both the process and the thread
+    meta_names = {ev["name"] for ev in events if ev["ph"] == "M"}
+    assert {"process_name", "thread_name"} <= meta_names
+
+
+def test_spans_nest_and_never_go_negative():
+    clock = FakeClock()
+    tracer = Tracer(clock=clock)
+    lane = tracer.lane("stage 0 [cpu]", "dispatch")
+    with tracer.span("outer", lane):
+        clock.t += 0.010
+        with tracer.span("inner", lane):
+            clock.t += 0.005
+        clock.t += 0.010
+    # a hostile clock that runs BACKWARDS must clamp, not emit dur < 0
+    t0 = tracer.now()
+    clock.t -= 1.0
+    tracer.complete("backwards", lane, t0)
+
+    by_name = {ev[1]: ev for ev in tracer.events()}
+    outer, inner = by_name["outer"], by_name["inner"]
+    # (ph, name, ts, dur, ...) tuples: child nests strictly inside parent
+    assert outer[2] <= inner[2]
+    assert inner[2] + inner[3] <= outer[2] + outer[3]
+    assert by_name["backwards"][3] == 0.0
+    for ev in tracer.events():
+        assert ev[3] >= 0.0
+
+
+def test_ring_buffer_bounds_memory():
+    tracer = Tracer(capacity=4, clock=FakeClock())
+    lane = tracer.lane("p", "t")
+    for i in range(10):
+        tracer.instant(f"e{i}", lane)
+    assert tracer.event_count == 4
+    assert tracer.dropped == 6
+    # newest events survive, oldest evict
+    assert [ev[1] for ev in tracer.events()] == ["e6", "e7", "e8", "e9"]
+
+
+def test_disabled_path_is_a_shared_noop():
+    assert telemetry.get_tracer() is None
+    # trace_span returns ONE module-level singleton: no allocation, and
+    # nothing records anywhere
+    s1 = telemetry.trace_span("a", "p", "t")
+    s2 = telemetry.trace_span("b", "p", "t")
+    assert s1 is s2 is _NULL_SPAN
+    with s1:
+        pass
+    # enable -> real spans; disable -> back to the singleton
+    tracer = telemetry.enable_tracing()
+    assert telemetry.trace_span("c", "p", "t") is not _NULL_SPAN
+    assert telemetry.enable_tracing() is tracer  # idempotent
+    assert telemetry.disable_tracing() is tracer
+    assert telemetry.get_tracer() is None
+
+
+def test_tracer_is_thread_safe():
+    tracer = Tracer(capacity=1 << 14)
+    errors = []
+
+    def work(i):
+        try:
+            lane = tracer.lane(f"proc {i % 3}", f"thr {i}")
+            for _ in range(200):
+                tracer.instant("tick", lane)
+        except Exception as exc:  # pragma: no cover - failure path
+            errors.append(exc)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert tracer.event_count == 8 * 200
+    # lane ids stayed unique under concurrent registration
+    lanes = set(tracer._lanes.values())
+    assert len(lanes) == len(tracer._lanes)
+
+
+# --------------------------------------------------------------------------
+# pipeline + TraceHook integration
+# --------------------------------------------------------------------------
+
+
+class _Loader:
+    def __init__(self, data, labels, n=2):
+        self._batch = (data, labels)
+        self._n = n
+
+    def __iter__(self):
+        for _ in range(self._n):
+            yield self._batch
+
+    def __len__(self):
+        return self._n
+
+
+def _run_traced_training(devices, tmp_path, hooks=()):
+    from skycomputing_tpu.runner import Runner, TraceHook
+
+    model, data, labels, ps = build_pipeline(
+        devices, n_workers=2, units=2, num_microbatches=2
+    )
+    runner = Runner(model, ps, model._worker_manager, max_epochs=1,
+                    max_iters=2)
+    trace_path = str(tmp_path / "train.trace.json")
+    runner.register_hook(TraceHook(trace_path))
+    for hook in hooks:
+        runner.register_hook(hook)
+    runner.train(_Loader(data, labels))
+    return runner, trace_path
+
+
+def test_training_run_produces_loadable_trace(devices, tmp_path):
+    _, trace_path = _run_traced_training(devices, tmp_path)
+    assert telemetry.get_tracer() is None  # hook released ownership
+    events = load_events(trace_path)  # strict JSON with traceEvents
+    for ev in events:
+        for key in ("ph", "ts", "pid", "tid", "name"):
+            assert key in ev
+    names = {ev["name"] for ev in events}
+    assert {"run_start", "run_end", "iter", "fwd", "bwd", "update"} <= names
+    iters = [ev for ev in events
+             if ev["ph"] == "X" and ev["name"] == "iter"]
+    assert len(iters) == 2
+    # both stages appear as their own process lanes
+    procs = {ev["args"]["name"] for ev in events
+             if ev["ph"] == "M" and ev["name"] == "process_name"}
+    assert sum(1 for p in procs if p.startswith("stage ")) == 2
+
+
+def test_trace_report_bubble_fraction_nonzero(devices, tmp_path):
+    """A real 2-stage pipeline trace yields nonzero bubble fraction and
+    per-stage utilization in (0, 1]."""
+    _, trace_path = _run_traced_training(devices, tmp_path)
+    report = analyze(load_events(trace_path))
+    assert report["num_stages"] == 2
+    assert 0.0 < report["bubble_fraction"] < 1.0
+    for util in report["stage_utilization"].values():
+        assert 0.0 < util <= 1.0
+    assert report["steps"]["count"] == 2
+    assert report["steps"]["p50_ms"] > 0
+    assert report["critical_path_ms"] > 0
+
+
+def test_trace_report_baseline_gate(devices, tmp_path):
+    _, trace_path = _run_traced_training(devices, tmp_path)
+    report = analyze(load_events(trace_path))
+
+    generous = tmp_path / "base_ok.json"
+    generous.write_text(json.dumps(
+        {"summary": {"step_ms": report["steps"]["p50_ms"] * 2,
+                     "bubble_fraction": 0.99}}
+    ))
+    regressing = tmp_path / "base_reg.json"
+    regressing.write_text(json.dumps(
+        {"step_ms": report["steps"]["p50_ms"] / 2,
+         "bubble_fraction": report["bubble_fraction"] / 4}
+    ))
+    assert report_main([trace_path, "--baseline", str(generous)]) == 0
+    assert report_main([trace_path, "--baseline", str(regressing)]) == 2
+    # extraction finds nested keys and takes the best (minimum) step
+    targets = baseline_targets(str(generous))
+    assert targets["step_ms"] == pytest.approx(
+        report["steps"]["p50_ms"] * 2
+    )
+    failures = check_regression(report, targets, tolerance=0.10)
+    assert failures == []
+
+
+def test_trace_report_smoke_fixture():
+    """The CI lint job's exact invocation: fixture analyzes clean."""
+    assert report_main(["--smoke"]) == 0
+
+
+def test_traced_steps_do_not_recompile(devices):
+    """The zero-steady-state-recompile pin holds WITH tracing enabled:
+    instrumentation must not perturb jit identity or argument structure
+    (training here; the serving twin is in test_serving.py)."""
+    model, data, labels, _ = build_pipeline(
+        devices, n_workers=2, units=2, num_microbatches=2
+    )
+    for schedule in ("gpipe", "1f1b"):
+        model.schedule = schedule
+        model.train_step(data, labels, rng=jax.random.key(0))  # warm
+        telemetry.enable_tracing()
+        try:
+            for i in range(2):
+                model.train_step(data, labels, rng=jax.random.key(i + 1))
+                assert model.stats.compiles == 0, (
+                    f"{schedule}: traced step recompiled"
+                )
+        finally:
+            telemetry.disable_tracing()
+
+
+@pytest.mark.perf
+def test_tracing_overhead_under_one_percent(devices):
+    """events_per_step x cost_per_event < 1% of the measured step time.
+
+    This is the robust form of the <1% contract: wall-clock A/B deltas
+    of ~100 events x ~1 us against a ~100 ms step are far inside host
+    noise, so the bound is asserted from the measured per-event cost and
+    the real traced event count instead.
+    """
+    model, data, labels, _ = build_pipeline(
+        devices, n_workers=2, units=2, num_microbatches=4
+    )
+    model.train_step(data, labels, rng=jax.random.key(0))  # warm
+    t0 = time.perf_counter()
+    model.train_step(data, labels, rng=jax.random.key(1))
+    jax.block_until_ready(model.stages[0].params)
+    step_s = time.perf_counter() - t0
+
+    tracer = telemetry.enable_tracing(capacity=1 << 18)
+    try:
+        n0 = tracer.event_count
+        model.train_step(data, labels, rng=jax.random.key(2))
+        events_per_step = tracer.event_count - n0
+    finally:
+        telemetry.disable_tracing()
+    assert events_per_step > 0
+
+    bench = Tracer(capacity=1 << 18)
+    lane = bench.lane("bench", "events")
+    n = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        bench.complete("e", lane, bench.now())
+    cost_s = (time.perf_counter() - t0) / n
+
+    overhead = events_per_step * cost_s / step_s
+    assert overhead < 0.01, (
+        f"tracing overhead {overhead:.2%} >= 1% "
+        f"({events_per_step} events x {cost_s * 1e6:.2f} us on a "
+        f"{step_s * 1e3:.1f} ms step)"
+    )
+
+
+# --------------------------------------------------------------------------
+# serving trace
+# --------------------------------------------------------------------------
+
+
+def test_serving_trace_has_phase_spans(tmp_path):
+    from skycomputing_tpu.builder import build_layer_stack
+    from skycomputing_tpu.models.gpt import GptConfig, gpt_layer_configs
+    from skycomputing_tpu.serving import Request, ServingEngine
+
+    cfg = GptConfig(vocab_size=256, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=2, max_position_embeddings=64,
+                    dropout_prob=0.0, dtype="float32")
+    layer_cfgs = gpt_layer_configs(cfg, deterministic=True)
+    stack = build_layer_stack(layer_cfgs)
+    params = stack.init(jax.random.key(0), np.ones((1, 5), np.int32))
+
+    tracer = telemetry.enable_tracing()
+    try:
+        engine = ServingEngine(layer_cfgs, list(params), num_slots=2,
+                               max_len=48, buckets=(8, 16),
+                               prefill_batch=1)
+        rng = np.random.default_rng(3)
+        requests = [
+            Request(prompt=rng.integers(1, 256, (l,)).astype(np.int32),
+                    max_new_tokens=4)
+            for l in (5, 9)
+        ]
+        outputs = engine.run(requests)
+        assert len(outputs) == 2
+        path = tracer.write(str(tmp_path / "serving.trace.json"))
+    finally:
+        telemetry.disable_tracing()
+
+    events = load_events(path)
+    for ev in events:
+        for key in ("ph", "ts", "pid", "tid", "name"):
+            assert key in ev
+    names = [ev["name"] for ev in events if ev["ph"] in ("X", "i")]
+    assert "prefill" in names and "decode" in names
+    assert names.count("admit") == 2
+    report = analyze(events)
+    assert report["serving"]["prefill_waves"] >= 1
+    assert report["serving"]["decode_ticks"] >= 1
+    assert report["serving"]["tpot_component_p50_ms"] > 0
+    # the engine's metrics registry speaks the unified snapshot contract
+    snap = engine.metrics.snapshot()
+    assert snap["serving"]["finished"] == 2
+
+
+# --------------------------------------------------------------------------
+# metrics unification + hook satellites
+# --------------------------------------------------------------------------
+
+
+def test_metrics_registry_unifies_stat_surfaces():
+    from skycomputing_tpu.parallel.pipeline import PipelineStats
+    from skycomputing_tpu.serving.engine import ServingStats
+
+    registry = MetricsRegistry()
+    pipeline_stats = PipelineStats(loss=1.5, dispatch_s=0.01)
+    serving_stats = ServingStats(iterations=7)
+    registry.register("pipeline", pipeline_stats)
+    registry.register("serving", serving_stats)
+    snap = registry.snapshot()
+    assert snap["pipeline"]["loss"] == 1.5
+    assert snap["serving"]["iterations"] == 7
+    flat = registry.flat()
+    assert flat["pipeline.dispatch_s"] == 0.01
+    assert "serving.tokens_per_s" in flat
+    # callable sources (a rebinding stats field) and contract violations
+    registry.register("lambda", lambda: {"x": 1})
+    assert registry.snapshot()["lambda"] == {"x": 1}
+    with pytest.raises(ValueError):
+        registry.register("pipeline", pipeline_stats)
+    with pytest.raises(TypeError):
+        registry.register("bad", 42)
+    registry.register("broken", lambda: [1, 2])
+    with pytest.raises(TypeError):
+        registry.snapshot()
+
+
+def test_pipeline_stats_snapshot_reaches_metrics_file(devices, tmp_path):
+    """MetricsHook consumes snapshot() verbatim: EVERY stats field is in
+    every record, so a field added to PipelineStats cannot silently miss
+    the metrics file again."""
+    import dataclasses
+
+    from skycomputing_tpu.parallel.pipeline import PipelineStats
+    from skycomputing_tpu.runner import MetricsHook, Runner
+
+    model, data, labels, ps = build_pipeline(
+        devices, n_workers=2, units=2
+    )
+    runner = Runner(model, ps, model._worker_manager, max_epochs=1,
+                    max_iters=2)
+    path = tmp_path / "metrics.jsonl"
+    runner.register_hook(MetricsHook(str(path)))
+    runner.train(_Loader(data, labels))
+
+    records = [json.loads(line) for line in path.read_text().splitlines()]
+    header, rows = records[0], records[1:]
+    assert header["event"] == "run_start"
+    assert header["world_size"] == 2
+    assert len(header["config_hash"]) == 12
+    field_names = {f.name for f in dataclasses.fields(PipelineStats)}
+    for row in rows:
+        assert field_names <= set(row)
+        assert row["run_id"] == header["run_id"]
+    # the runner-side registry exposes the same surface
+    assert set(runner.metrics.snapshot()["pipeline"]) == field_names
+
+
+def test_metrics_hook_restart_and_crash_semantics(devices, tmp_path):
+    """Restarted runs are separable by run_id; a raising run still gets
+    its records flushed and the file closed."""
+    from skycomputing_tpu.runner import Hook, MetricsHook, Runner
+
+    model, data, labels, ps = build_pipeline(
+        devices, n_workers=2, units=2
+    )
+    path = tmp_path / "metrics.jsonl"
+
+    class Boom(Hook):
+        def after_iter(self, runner):
+            if runner.iter >= 2:
+                raise RuntimeError("injected")
+
+    hook = MetricsHook(str(path))
+    runner = Runner(model, ps, model._worker_manager, max_epochs=1,
+                    max_iters=2)
+    runner.register_hook(hook)
+    runner.train(_Loader(data, labels))
+
+    hook2 = MetricsHook(str(path))
+    runner2 = Runner(model, ps, model._worker_manager, max_epochs=1,
+                     max_iters=4)
+    runner2.register_hook(hook2)
+    runner2.register_hook(Boom())
+    with pytest.raises(RuntimeError, match="injected"):
+        runner2.train(_Loader(data, labels, n=4))
+    assert hook2._fh is None  # closed from the finally-driven after_run
+
+    records = [json.loads(line) for line in path.read_text().splitlines()]
+    headers = [r for r in records if r.get("event") == "run_start"]
+    assert len(headers) == 2
+    run_ids = {h["run_id"] for h in headers}
+    assert len(run_ids) == 2
+    # every data record belongs to exactly one run, including the
+    # crashed run's records (flushed despite the raise)
+    by_run = {}
+    for r in records:
+        if "event" not in r:
+            by_run.setdefault(r["run_id"], []).append(r)
+    assert sorted(len(v) for v in by_run.values()) == [2, 2]
+    # run 2 changed the loop bounds (max_iters 2 -> 4): the config hash
+    # must tell the two configurations apart
+    assert all(len(h["config_hash"]) == 12 for h in headers)
+    assert headers[0]["config_hash"] != headers[1]["config_hash"]
+
+
+def test_logger_levels_and_utc(tmp_path):
+    import re
+
+    from skycomputing_tpu.utils import Logger
+
+    path = tmp_path / "log.txt"
+    logger = Logger(filename=str(path))
+    logger.info("plain message")
+    logger.warning("something odd")
+    logger.error("something broke")
+    logger.close()
+    lines = path.read_text().splitlines()
+    # default format byte-compatible: "[YYYY-mm-dd HH:MM:SS] message"
+    assert re.fullmatch(
+        r"\[\d{4}-\d{2}-\d{2} \d{2}:\d{2}:\d{2}\] plain message", lines[0]
+    )
+    assert re.fullmatch(
+        r"\[\d{4}-\d{2}-\d{2} \d{2}:\d{2}:\d{2}\] WARNING: something odd",
+        lines[1],
+    )
+    assert lines[2].endswith("ERROR: something broke")
+
+    utc_path = tmp_path / "utc.txt"
+    utc_logger = Logger(filename=str(utc_path), utc=True)
+    utc_logger.info("utc line")
+    utc_logger.close()
+    assert re.fullmatch(
+        r"\[\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}Z\] utc line",
+        utc_path.read_text().strip(),
+    )
