@@ -35,8 +35,9 @@ from .dynamics import (
     WorkerManager,
 )
 from .parallel import PipelineModel, StageRuntime
-from .runner import Hook, Runner
+from .runner import AutotuneHook, Hook, Runner
 from .serving import Request, ServingEngine
+from .tuning import ServingAutotuner, TuningAdvisor
 from .stimulator import Stimulator
 from .telemetry import (
     MetricsRegistry,
@@ -77,8 +78,11 @@ __all__ = [
     "StageRuntime",
     "Hook",
     "Runner",
+    "AutotuneHook",
     "Request",
     "ServingEngine",
+    "ServingAutotuner",
+    "TuningAdvisor",
     "Stimulator",
     "MetricsRegistry",
     "Tracer",

@@ -28,6 +28,7 @@ from .plan_check import (
     verify_allocation_payload,
     verify_pipeline,
     verify_plan,
+    verify_tuning_knobs,
 )
 
 __all__ = [
@@ -43,4 +44,5 @@ __all__ = [
     "verify_allocation_payload",
     "verify_pipeline",
     "verify_plan",
+    "verify_tuning_knobs",
 ]

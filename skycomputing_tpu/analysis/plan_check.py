@@ -831,6 +831,68 @@ def _verify_serving_payload(serving: Any) -> List[str]:
     return problems
 
 
+def verify_tuning_knobs(
+    *,
+    schedule: Optional[str] = None,
+    num_microbatches: Optional[int] = None,
+    batch_size: Optional[int] = None,
+    buckets: Optional[Sequence[int]] = None,
+    max_len: Optional[int] = None,
+    num_slots: Optional[int] = None,
+    prefill_batch: Optional[int] = None,
+) -> PlanReport:
+    """Pre-flight a *knob-level* operating-point change (no eval_shape).
+
+    The autotuner's non-allocation proposals — schedule swaps,
+    microbatch counts, serving bucket sets, slot counts — change no
+    layer partition, so the shape/memory/donation verifier has nothing
+    to trace; what CAN go wrong is arithmetic (a microbatch count that
+    does not divide the batch silently truncates data; a bucket past
+    the slab depth admits prompts the cache cannot hold).  This check
+    is the same verify-then-apply contract at knob granularity: every
+    proposal passes through a verifier before it is applied, and a
+    rejection carries a precise diagnostic instead of failing inside
+    the engine.  Only the knobs passed are checked.
+    """
+    t0 = time.perf_counter()
+    issues: List[PlanIssue] = []
+
+    def err(msg: str) -> None:
+        issues.append(PlanIssue("knobs", "error", msg))
+
+    if schedule is not None and schedule not in ("gpipe", "1f1b"):
+        err(f"unknown schedule {schedule!r}; use 'gpipe' or '1f1b'")
+    if num_microbatches is not None:
+        if not _pos_int(num_microbatches):
+            err(f"num_microbatches must be a positive int, got "
+                f"{num_microbatches!r}")
+        elif batch_size is not None and batch_size % num_microbatches:
+            err(f"microbatch count {num_microbatches} does not divide "
+                f"batch size {batch_size} — a ragged split would "
+                f"silently drop examples")
+    if num_slots is not None and not _pos_int(num_slots):
+        err(f"num_slots must be a positive int, got {num_slots!r}")
+    if prefill_batch is not None and not _pos_int(prefill_batch):
+        err(f"prefill_batch must be a positive int, got {prefill_batch!r}")
+    if buckets is not None:
+        # synthesize a max_len fallback from the WELL-FORMED buckets
+        # only: a malformed entry must surface as a PlanIssue below,
+        # never as a TypeError out of max()
+        well_formed = [b for b in buckets if _pos_int(b)]
+        problems = _verify_serving_payload(
+            dict(slots=num_slots if _pos_int(num_slots) else 1,
+                 max_len=max_len if _pos_int(max_len) else (
+                     max(well_formed) if well_formed else 1),
+                 buckets=list(buckets))
+        )
+        for p in problems:
+            err(p)
+
+    report = PlanReport(issues=issues, checks=["knobs"],
+                        elapsed_s=time.perf_counter() - t0)
+    return report
+
+
 __all__ = [
     "PlanError",
     "PlanIssue",
@@ -839,4 +901,5 @@ __all__ = [
     "verify_allocation_payload",
     "verify_pipeline",
     "verify_plan",
+    "verify_tuning_knobs",
 ]

@@ -48,6 +48,34 @@ class Allocator:
         self._speed_override: Dict[str, float] = {}
 
     # ------------------------------------------------------------------ util
+    @property
+    def model_config(self) -> List[Dict]:
+        """The per-layer config list this allocator partitions — the
+        exact list a plan verifier needs (``verify_plan(model_config,
+        worker_manager, batch)``), exposed so closed-loop callers (the
+        autotuner) don't reach into privates."""
+        return self._model_cfg
+
+    def snapshot_calibration(self) -> Dict[str, object]:
+        """Everything :meth:`restore_calibration` needs to undo learned
+        corrections: the per-layer cost override and the per-device
+        speed override.  A rolled-back tuning proposal must revert BOTH
+        the partition and the calibration that produced it — otherwise
+        the next solve re-derives the same rejected plan from the
+        poisoned model."""
+        return {
+            "cost": (
+                list(self._cost_override)
+                if self._cost_override is not None else None
+            ),
+            "speed": dict(self._speed_override),
+        }
+
+    def restore_calibration(self, snapshot: Dict[str, object]) -> None:
+        cost = snapshot["cost"]
+        self._cost_override = list(cost) if cost is not None else None
+        self._speed_override = dict(snapshot["speed"])
+
     def _profiles(self):
         device_results = self._device_benchmarker.benchmark()
         layer_flops, layer_mem = self._model_benchmarker.benchmark()

@@ -1,5 +1,6 @@
 from .hooks import Hook
 from .hooks_collection import (
+    AutotuneHook,
     CheckpointHook,
     DistributedTimerHelperHook,
     EvalHook,
@@ -16,6 +17,7 @@ from .runner import Runner
 __all__ = [
     "Hook",
     "Runner",
+    "AutotuneHook",
     "CheckpointHook",
     "DistributedTimerHelperHook",
     "EvalHook",

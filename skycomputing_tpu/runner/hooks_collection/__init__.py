@@ -1,3 +1,4 @@
+from .autotune_hook import AutotuneHook
 from .checkpoint_hook import CheckpointHook
 from .eval_hook import EvalHook
 from .heartbeat_hook import HeartbeatHook
@@ -9,6 +10,7 @@ from .trace_hook import TraceHook
 from .watchdog_hook import NanGuardHook, WatchdogHook
 
 __all__ = [
+    "AutotuneHook",
     "CheckpointHook",
     "EvalHook",
     "HeartbeatHook",

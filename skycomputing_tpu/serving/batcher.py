@@ -188,6 +188,21 @@ class AdmissionQueue:
         )
         self._queue.append(request)
 
+    @property
+    def requests(self) -> Tuple[Request, ...]:
+        """Queued requests, FIFO order (read-only view)."""
+        return tuple(self._queue)
+
+    def drain(self) -> List[Request]:
+        """Remove and return every queued request, FIFO order.
+
+        Used by ``ServingEngine.reconfigure``: an operating-point change
+        rebuilds the queue around a new bucketer, so the old queue's
+        contents re-submit (re-bucket) into the new one.
+        """
+        drained, self._queue = self._queue, []
+        return drained
+
     def next_wave(self, free_slots: int) -> Optional[List[Request]]:
         """Dequeue the next same-bucket prefill wave, or None.
 

@@ -173,6 +173,7 @@ class _ServingStage:
             )
             for i in attn_indices(self.modules)
         ]
+        self.specs = specs
         self.pool = SlotKVCachePool(specs, num_slots, device=device)
         mods, stage_specs = self.modules, specs
 
@@ -221,6 +222,17 @@ class _ServingStage:
         else:
             self._decode_donated = jax.jit(decode)
             self._prefill_donated = jax.jit(prefill)
+
+    def build_pool(self, num_slots: int) -> SlotKVCachePool:
+        """A fresh (unassigned) slab pool for a new slot count.
+
+        Engine ``reconfigure`` pre-builds every stage's new pool BEFORE
+        evicting anything, so a slab-allocation failure (device OOM on
+        a larger slot count) surfaces while the engine is still fully
+        intact.  The decode/prefill programs re-trace once for the new
+        slab shape — a deliberate, visible warmup cost, the same one
+        engine construction pays."""
+        return SlotKVCachePool(self.specs, num_slots, device=self.device)
 
 
 class ServingEngine:
@@ -290,10 +302,20 @@ class ServingEngine:
         self.metrics.register("serving", lambda: self.stats.snapshot())
         self._running: Dict[int, Request] = {}  # request_id -> Request
         self._finished: List[Request] = []
+        # closed-loop tuning: when set (tuning.ServingAutotuner attaches
+        # itself here), every step ends with an observe/decide callback —
+        # the serving twin of the Runner's AutotuneHook
+        self.autotuner = None
 
         self._devices = (
             list(devices) if devices is not None else jax.devices()
         )
+        # retained for reconfigure's re-run of the serving pre-flight
+        # (slab memory vs budgets) against a proposed operating point;
+        # the preflight opt-out carries over so both checks agree
+        self._model_cfg = list(model_cfg)
+        self._worker_manager = worker_manager
+        self._preflight = bool(preflight)
         counts, stage_devices = self._resolve_stage_plan(
             worker_manager, partition, len(modules)
         )
@@ -459,6 +481,156 @@ class ServingEngine:
         self.stats.iterations += 1
         self.stats.queue_depth = self._queue.depth
         self.stats.batch_occupancy = self.stages[0].pool.occupancy
+        if self.autotuner is not None:
+            self.autotuner.on_step(self)
+
+    def reconfigure(
+        self,
+        *,
+        buckets: Optional[Sequence[int]] = None,
+        num_slots: Optional[int] = None,
+        prefill_batch: Optional[int] = None,
+    ) -> None:
+        """Apply a new serving operating point IN PLACE, between steps.
+
+        The act half of the serving tuning loop: bucket set, slot count,
+        and prefill wave width are all shape knobs, so changing them
+        means new compiled programs — but not a new engine.  The queue
+        re-buckets under the new set; ONLY a slot-count change (which
+        rebuilds the per-stage slabs) additionally evicts the running
+        batch recomputation-style (the :meth:`preempt` machinery: token
+        streams preserved exactly, KV prefixes rebuilt on re-admission)
+        — bucket/wave-width changes leave running requests decoding
+        untouched.
+
+        Verify-then-apply: the knob set passes the pre-flight verifier
+        (``analysis/plan_check.verify_tuning_knobs``), a slot-count
+        change re-runs the constructor's serving memory pre-flight
+        (budget-charged slabs, when the engine was built from a worker
+        manager) AND pre-builds the new slabs, and every live request
+        is proven to fit the new bucket set — all BEFORE any state is
+        touched, so a rejected reconfigure (:class:`PlanError` /
+        ``ValueError`` / a slab-allocation failure) leaves the engine
+        exactly as it was.
+        """
+        from ..analysis.plan_check import verify_tuning_knobs
+
+        if buckets is not None:
+            # same normalization the constructor's ShapeBucketer applies,
+            # so reconfigure accepts exactly the inputs construction
+            # does; a malformed entry is left raw for the knob verifier
+            # to reject with a diagnostic (never a bare TypeError here)
+            try:
+                new_buckets = tuple(sorted(set(int(b) for b in buckets)))
+            except (TypeError, ValueError):
+                new_buckets = tuple(buckets)
+        else:
+            new_buckets = self.bucketer.buckets
+        new_slots = (
+            int(num_slots) if num_slots is not None else self.num_slots
+        )
+        new_batch = (
+            int(prefill_batch)
+            if prefill_batch is not None else self.prefill_batch
+        )
+        verify_tuning_knobs(
+            buckets=new_buckets, max_len=self.max_len,
+            num_slots=new_slots, prefill_batch=new_batch,
+        ).raise_if_failed()
+        if (self._preflight and self._worker_manager is not None
+                and (new_slots != self.num_slots
+                     or max(new_buckets) > self.bucketer.max_bucket)):
+            # same pre-flight the constructor ran, against the PROPOSED
+            # operating point: a slab or prefill activation that no
+            # longer fits the budgets (more slots, OR a raised max
+            # bucket) must be rejected abstractly, not discovered as an
+            # allocation OOM mid-serving.  A slot change is charged at
+            # old+new slots: the atomic apply below holds BOTH pools
+            # resident for a moment, and that transient peak — not the
+            # steady state — is what the apply must actually fit.
+            from ..analysis.plan_check import verify_plan
+
+            charged_slots = new_slots + (
+                self.num_slots if new_slots != self.num_slots else 0
+            )
+            verify_plan(
+                self._model_cfg, self._worker_manager,
+                (np.zeros((new_slots, 1), np.int32),),
+                memory="error", check_donation=False,
+                serving=dict(slots=charged_slots, max_len=self.max_len,
+                             bucket=max(new_buckets)),
+            ).raise_if_failed()
+        new_bucketer = ShapeBucketer(new_buckets)
+        # only a slot-count change rebuilds the slabs and therefore
+        # forces eviction; bucket/prefill_batch changes keep the running
+        # batch decoding untouched (running requests never consult the
+        # bucketer mid-decode) and only re-bucket the queue
+        must_evict = new_slots != self.num_slots
+        # feasibility covers the RUNNING batch even when it stays
+        # resident: a running request that no longer fits any bucket
+        # could never be preempted or rolled back again — a latent trap
+        # the engine must refuse to set
+        live = list(self._running.values()) + list(self._queue.requests)
+        for r in live:
+            # a request grown past the largest NEW bucket cannot resume
+            # by recomputation; reject before any eviction
+            try:
+                new_bucketer.bucket_for(int(r.effective_prompt.size))
+            except ValueError as exc:
+                raise ValueError(
+                    f"reconfigure rejected: request {r.request_id} "
+                    f"cannot resume under buckets {list(new_buckets)}: "
+                    f"{exc}"
+                ) from None
+        # pre-build every stage's new slabs BEFORE touching any request
+        # state: an allocation failure here leaves the engine exactly as
+        # it was (the atomicity the docstring promises); old slabs free
+        # as soon as the swap below drops them
+        new_pools = (
+            [st.build_pool(new_slots) for st in self.stages]
+            if must_evict else None
+        )
+
+        tracer = get_tracer()
+        old = dict(buckets=list(self.bucketer.buckets),
+                   slots=self.num_slots, prefill_batch=self.prefill_batch)
+        evicted: List[Request] = []
+        if must_evict:
+            for r in list(self._running.values()):
+                self._running.pop(r.request_id)
+                self._release_slot(r.slot)
+                r.slot = None
+                r.preemptions += 1
+                self.stats.preemptions += 1
+                evicted.append(r)
+                if tracer is not None:
+                    # same instant preempt() emits, so trace-derived
+                    # preemption counts agree with ServingStats
+                    tracer.instant(
+                        "preempt", tracer.lane("serving", "engine"),
+                        {"request": r.request_id, "reconfigure": True},
+                    )
+        queued = self._queue.drain()
+        if new_pools is not None:
+            self.num_slots = new_slots
+            for st, pool in zip(self.stages, new_pools):
+                st.pool = pool
+        self.bucketer = new_bucketer
+        self.prefill_batch = new_batch
+        self._queue = AdmissionQueue(new_bucketer, prefill_batch=new_batch)
+        # evicted requests were admitted before anything still queued:
+        # they re-enter at the head so reconfiguration cannot starve them
+        for r in evicted + queued:
+            self._queue.submit(r)
+        self.stats.queue_depth = self._queue.depth
+        if tracer is not None:
+            tracer.instant(
+                "reconfigure", tracer.lane("serving", "engine"),
+                dict(old=old, new=dict(buckets=list(new_buckets),
+                                       slots=new_slots,
+                                       prefill_batch=new_batch),
+                     evicted=len(evicted)),
+            )
 
     def run(
         self,
@@ -540,10 +712,15 @@ class ServingEngine:
         jax.block_until_ready(tokens)
         now = time.perf_counter()
         self.stats.prefill_s += now - t0
+        wave_tokens = int(lengths[: len(wave)].sum())
         if tracer is not None:
+            # tokens (true, un-padded) ride along so trace analysis can
+            # compute per-bucket padding waste — the skewed-bucket
+            # signature the autotuner acts on
             tracer.complete(
                 "prefill", tracer.lane("serving", "engine"), span0,
-                {"bucket": bucket, "wave": len(wave)},
+                {"bucket": bucket, "wave": len(wave),
+                 "tokens": wave_tokens},
             )
             for r in wave:
                 tracer.instant(
@@ -551,7 +728,7 @@ class ServingEngine:
                     {"request": r.request_id, "slot": r.slot},
                 )
         self.stats.prefill_waves += 1
-        self.stats.prefill_tokens += int(lengths[: len(wave)].sum())
+        self.stats.prefill_tokens += wave_tokens
         # per-call delta, not a process-global diff: foreign jit work in
         # the same process must not read as engine recompiles
         self.stats.compiles += xla_compile_count() - compiles0

@@ -5,6 +5,7 @@ serving, tools) without pulling jax, and cheap enough to leave wired in
 production code paths permanently (disabled tracing is a ``None`` check).
 """
 
+from . import analysis
 from .metrics import MetricsRegistry
 from .tracer import (
     Tracer,
@@ -15,6 +16,7 @@ from .tracer import (
 )
 
 __all__ = [
+    "analysis",
     "MetricsRegistry",
     "Tracer",
     "disable_tracing",

@@ -210,7 +210,7 @@ class Tracer:
         return list(self._events)
 
     # --- export -------------------------------------------------------------
-    def to_chrome(self) -> Dict[str, Any]:
+    def to_chrome(self, since_us: Optional[float] = None) -> Dict[str, Any]:
         """The trace as a Chrome Trace Event Format object.
 
         Every event (metadata included) carries the full required key
@@ -218,6 +218,11 @@ class Tracer:
         validate one uniform schema.  Lane metadata (process/thread
         names, sort order) is emitted first; viewers apply it to all
         subsequent events regardless of buffer eviction.
+
+        ``since_us`` exports only events with ``ts >= since_us`` (lane
+        metadata always included) — the autotuner analyzes one window
+        at a time, and filtering raw tuples here beats materializing
+        the full ring buffer just to discard most of it.
         """
         out: List[Dict[str, Any]] = []
         with self._lock:
@@ -237,6 +242,8 @@ class Tracer:
             out.append({"ph": "M", "name": "thread_name", "ts": 0.0,
                         "pid": pid, "tid": tid, "args": {"name": thread}})
         for ph, name, ts, dur, pid, tid, args, aid in list(self._events):
+            if since_us is not None and ts < since_us:
+                continue
             ev: Dict[str, Any] = {"ph": ph, "name": name, "ts": ts,
                                   "pid": pid, "tid": tid}
             if ph == "X":

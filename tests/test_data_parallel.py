@@ -64,6 +64,7 @@ def test_replicas_stay_identical_over_steps(devices):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_dp_checkpoint_resume_through_hook(devices, tmp_path):
     """Save + restore a DP run via CheckpointHook, incl. training state."""
     import os.path as osp
@@ -104,6 +105,7 @@ def test_dp_checkpoint_resume_through_hook(devices, tmp_path):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_dp_1f1b_schedule_matches_gpipe(devices):
     """schedule='1f1b' plumbs through to the replicas and computes the
     same step as GPipe (same math, different issue order)."""

@@ -106,6 +106,7 @@ def test_paper_scale_allocation_certified_optimal():
     )
 
 
+@pytest.mark.slow
 def test_bench_cpu_fallback_instance_quick():
     """Dev-tier single-draw check of the shipped instance: speedup only.
     One timed profile keeps the not-slow tier fast (~2 min here, vs ~6

@@ -119,6 +119,7 @@ def test_large_native_covers_and_is_deterministic():
     assert abs(worst - bott_a) < 1e-9
 
 
+@pytest.mark.slow
 def test_large_native_not_worse_than_python_greedy():
     """The whole point of the native anneal: at the same wall budget it
     must match or beat the pure-Python greedy+anneal's bottleneck."""

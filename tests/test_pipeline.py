@@ -191,6 +191,7 @@ def test_slowdown_inflates_step_time(devices):
     assert t_slow > t_fast * 2, (t_fast, t_slow)
 
 
+@pytest.mark.slow
 def test_default_rng_is_deterministic_across_runs(devices):
     """With dropout live and no caller rng, two identically-built models
     replay the same per-call keys (counter-folded, not wall-clock)."""

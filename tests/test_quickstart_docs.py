@@ -7,7 +7,10 @@ documented snippet, this fails before a user finds out.
 import os.path as osp
 import re
 
+import pytest
 
+
+@pytest.mark.slow
 def test_quickstart_blocks_run(devices, tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)  # any files the blocks write land here
     path = osp.join(osp.dirname(osp.dirname(osp.abspath(__file__))),

@@ -89,6 +89,7 @@ def test_reallocation_resume_falls_back_to_params_only(devices, tmp_path):
     assert r2.epoch == 1 and r2.iter == 8
 
 
+@pytest.mark.slow
 def test_exact_resume_with_live_dropout(devices, tmp_path):
     """With dropout active, exact resume requires the rng stream to be
     checkpointed too — this guards the saved split-chain key."""

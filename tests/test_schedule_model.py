@@ -14,6 +14,8 @@ the headline methodology wholesale.
 import importlib.util
 import os.path as osp
 
+import pytest
+
 
 def _load_tool():
     path = osp.join(osp.dirname(osp.dirname(osp.abspath(__file__))),
@@ -25,6 +27,7 @@ def _load_tool():
     return mod
 
 
+@pytest.mark.slow
 def test_composition_claim_small_scale(devices):
     v = _load_tool()
     n = min(4, len(devices))

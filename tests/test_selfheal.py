@@ -273,6 +273,7 @@ def _prewarm_slice_programs(mc, ps, x, max_len):
         jax.block_until_ready(programs.update(params, opt_state, grads))
 
 
+@pytest.mark.slow
 def test_straggler_triggers_one_heal_and_beats_no_heal_control(devices,
                                                                tmp_path):
     """Seeded FaultPlan makes worker 0 (initially the largest stage) 3x

@@ -104,6 +104,7 @@ def test_heterogeneous_beats_even_bottleneck():
 
 
 @pytest.mark.parametrize("seed", range(10))
+@pytest.mark.slow
 def test_fuzz_invariants_hold(seed):
     """Any feasible instance: full contiguous coverage, memory respected,
     no device used twice, and exact (when available) never loses to the
@@ -155,6 +156,7 @@ def test_fuzz_invariants_hold(seed):
         assert res.bottleneck <= greedy.bottleneck * (1 + 1e-4)
 
 
+@pytest.mark.slow
 def test_large_cluster_greedy_path():
     rng = random.Random(7)
     L, D = 160, 64

@@ -76,6 +76,7 @@ def test_full_train_step_compiles_and_learns(world):
     assert len(leaf.sharding.device_set) == 4
 
 
+@pytest.mark.slow
 def test_grads_match_host_pipeline_semantics(world):
     """SPMD grads == plain autodiff over the sequential composition."""
     pipe, params, batch, labels, _ = world

@@ -67,6 +67,7 @@ def test_rate0_matches_deterministic_engine(devices):
     np.testing.assert_allclose(l_det, l_sto, rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_seeded_determinism_and_divergence(devices):
     mesh = make_pipeline_mesh(4, devices[:4])
     batch, labels = bert_data()
@@ -122,6 +123,7 @@ def test_dropout_through_interleaved_schedule(devices):
     assert np.isfinite(a)
 
 
+@pytest.mark.slow
 def test_dropout_composes_with_dp_and_tp(devices):
     """dp x pp x tp stochastic engine: rate 0 still matches the plain
     deterministic engine given the same full weights (the tp dropout
@@ -163,6 +165,7 @@ def test_dropout_composes_with_dp_and_tp(devices):
     assert np.isfinite(a) and a == b
 
 
+@pytest.mark.slow
 def test_gpt_dropout_rate0_and_seeded(devices):
     cfg = dict(tiny_gpt_config().to_dict(), dropout_prob=0.0)
     mesh = make_pipeline_mesh(2, devices[:2])

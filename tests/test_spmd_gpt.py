@@ -85,6 +85,7 @@ def test_gpt_pipeline_zero1(devices):
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow
 def test_gpt_zero3_matches_replicated(devices):
     """The inherited ZeRO-3 path is exact for the GPT engine too."""
     import optax

@@ -142,6 +142,7 @@ def test_moe_interleaved_wavefront_m_le_s_matches_sequential(devices):
     np.testing.assert_allclose(float(aux), np.mean(ref_aux), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_moe_padded_grouped_interleaved_trains(devices):
     """MoE + grouped interleaving with a padded M (M=6, S=2 -> S|M holds;
     use M=3, S=2 to force the padding path) trains to decreasing loss."""

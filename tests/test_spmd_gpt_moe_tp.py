@@ -53,6 +53,7 @@ def test_moe_split_merge_roundtrip(devices):
 
 
 @pytest.mark.parametrize("dp", [1, 2])
+@pytest.mark.slow
 def test_gpt_moe_tp_pipeline_matches_plain(devices, dp):
     """(dp x) pp x tp MoE == plain pp MoE with the same full weights."""
     cfg = _cfg()

@@ -60,6 +60,7 @@ def test_interleaved_trains(world):
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_interleaved_padded_non_multiple_m_matches_sequential(devices):
     """M=6 with S=4 pads to M'=8 grouped microbatches; pads are sliced
     away, so the schedule must still equal sequential chunk application."""

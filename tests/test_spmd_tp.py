@@ -51,6 +51,7 @@ def test_split_merge_roundtrip(devices):
 
 
 @pytest.mark.parametrize("dp", [1, 2])
+@pytest.mark.slow
 def test_tp_pipeline_matches_plain(devices, dp):
     """dp x pp x tp == dp x pp with the same full weights, step for step."""
     cfg = _cfg()

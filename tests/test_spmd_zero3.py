@@ -79,6 +79,7 @@ def test_zero3_guards(devices):
 
 
 
+@pytest.mark.slow
 def test_zero3_composes_with_tp(devices):
     """dp x pp x tp mesh with zero3 == same mesh without, step for step."""
     from skycomputing_tpu.parallel import make_dp_pp_tp_mesh
@@ -108,6 +109,7 @@ def test_zero3_composes_with_tp(devices):
         np.testing.assert_allclose(float(loss_p), float(loss_z), rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_zero3_composes_with_interleaved(devices):
     """zero3 + virtual stages: per-tick FSDP gather, exact parity."""
     cfg = bert_config("tiny", dtype="float32", hidden_dropout_prob=0.0,

@@ -113,6 +113,7 @@ def test_unknown_layer_type_rejected():
         convert_layer("MysteryLayer", {})
 
 
+@pytest.mark.slow
 def test_bit_roundtrip_bert_base_scale(tmp_path):
     """flax -> torch file -> flax at BERT-base dims, bit-for-bit."""
     from skycomputing_tpu.utils.torch_convert import to_torch_state_dict
@@ -138,6 +139,7 @@ def test_bit_roundtrip_bert_base_scale(tmp_path):
         )
 
 
+@pytest.mark.slow
 def test_hf_bert_checkpoint_matches_torch_logits():
     """Converted HF weights reproduce transformers' own logits."""
     transformers = pytest.importorskip("transformers")
@@ -179,6 +181,7 @@ def test_hf_bert_checkpoint_matches_torch_logits():
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_finetune_from_converted_weights_beats_random_init(tmp_path):
     """The reference's headline flow: start from released weights, not
     random init (``/root/reference/experiment/config.py:22``).  Train a
@@ -245,6 +248,7 @@ def test_finetune_from_converted_weights_beats_random_init(tmp_path):
     assert end < 0.5 * random_loss, (end, random_loss)
 
 
+@pytest.mark.slow
 def test_reference_scale_pth_roundtrip_two_allocations(tmp_path):
     """VERDICT r03 task #6: BERT-large (L-24/H-1024/A-16) reference-layout
     .pth through the converter, loaded under TWO allocations, fine-tuned.

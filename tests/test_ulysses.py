@@ -52,6 +52,7 @@ def test_ulysses_matches_ring(sp_mesh):
                                rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow
 def test_ulysses_grads_match(sp_mesh):
     q, k, v = _qkv(jax.random.key(3), B=1, L=32, H=8, D=8)
 

@@ -1,0 +1,250 @@
+#!/usr/bin/env python
+"""Autotuner benchmark + CI smoke: does telemetry spend itself?
+
+Two modes:
+
+``--smoke`` (the CI lint-job invocation, pure stdlib — no jax): runs
+the decide step of the closed loop on the checked-in synthetic
+straggler trace (``tools/fixtures/trace_straggler.json``): analysis
+must find the straggler lane, the :class:`TuningAdvisor` must map the
+signature to an ``allocation`` proposal naming the slow stage's
+measured seconds, and a clean balanced report must map to *no*
+proposal.  Structural drift in the analysis schema or the advisor's
+signature table fails the job.
+
+Default mode (needs jax): end-to-end loop benchmark on the 8-fake-CPU
+harness — build a small BERT pipeline with one 3x-slowed worker, train
+with ``AutotuneHook`` wired to the allocator, and report the pre-tune
+vs post-tune step p50 plus the hook's event log.  ``--out`` writes a
+BENCH-style JSON artifact ``tools/trace_report.py --baseline`` can gate
+against.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+from typing import Optional
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures", "trace_straggler.json")
+
+
+def _load_by_path(name: str, *parts: str):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ROOT, *parts)
+    )
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[name] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+# Prefer the package (shared module objects in a dev process); fall back
+# to file-path loads on bare CI runners with no jax install — both the
+# analysis library and the advisor are pure stdlib by contract.
+try:
+    from skycomputing_tpu.telemetry import analysis as _analysis
+    from skycomputing_tpu.tuning import advisor as _advisor
+except Exception:  # pragma: no cover - exercised on bare CI runners
+    _analysis = _load_by_path(
+        "skytpu_trace_analysis",
+        "skycomputing_tpu", "telemetry", "analysis.py",
+    )
+    _advisor = _load_by_path(
+        "skytpu_tuning_advisor",
+        "skycomputing_tpu", "tuning", "advisor.py",
+    )
+
+
+def run_smoke() -> int:
+    problems = []
+    report = _analysis.analyze(_analysis.load_events(_FIXTURE))
+    advisor = _advisor.TuningAdvisor()
+
+    proposal = advisor.propose_training(
+        report, schedule="gpipe", num_microbatches=2, batch_size=8,
+    )
+    if proposal is None:
+        problems.append("straggler fixture produced no proposal")
+    else:
+        if proposal.knob != "allocation":
+            problems.append(
+                f"straggler fixture proposed {proposal.knob!r}, "
+                f"expected 'allocation'"
+            )
+        else:
+            measured = list(proposal.value)
+            if len(measured) != report["num_stages"]:
+                problems.append(
+                    f"proposal carries {len(measured)} stage times for "
+                    f"{report['num_stages']} stages"
+                )
+            elif measured.index(max(measured)) != 1:
+                problems.append(
+                    f"fixture's straggler is stage 1, proposal blames "
+                    f"stage {measured.index(max(measured))}"
+                )
+        print(f"# straggler: {proposal.signature} -> {proposal.knob} "
+              f"({proposal.reason})")
+
+    # a balanced, low-bubble report must read as clean (no thrash)
+    clean = {
+        "stage_busy_ms": {"0": 90.0, "1": 92.0, "2": 91.0},
+        "bubble_fraction": 0.08,
+        "steps": {"count": 10, "p50_ms": 10.0},
+    }
+    noop = advisor.propose_training(
+        clean, schedule="1f1b", num_microbatches=4, batch_size=8,
+    )
+    if noop is not None:
+        problems.append(f"clean report produced {noop.describe()}")
+    else:
+        print("# clean report: no-op")
+
+    # skewed serving buckets must map to a bucket-set proposal
+    skew = {
+        "stage_busy_ms": {"0": 50.0},
+        "bubble_fraction": 0.2,
+        "serving": {
+            "prefill_waves": 20, "decode_ticks": 80, "queue_stalls": 0,
+            "padding_fraction": 0.8438,
+            "buckets": {"64": {"waves": 20, "requests": 20,
+                               "tokens": 200, "padded_fraction": 0.84}},
+        },
+    }
+    bucket_prop = advisor.propose_serving(
+        skew, buckets=(64,), num_slots=4, max_len=128,
+    )
+    if bucket_prop is None or bucket_prop.knob != "buckets":
+        problems.append(
+            f"skewed-bucket report proposed "
+            f"{getattr(bucket_prop, 'knob', None)!r}, expected 'buckets'"
+        )
+    else:
+        print(f"# skewed buckets: -> {list(bucket_prop.value)}")
+
+    if problems:
+        for p in problems:
+            print(f"bench_autotune --smoke: {p}", file=sys.stderr)
+        return 1
+    print("# smoke: ok")
+    return 0
+
+
+def run_bench(iters: int, out: Optional[str]) -> int:
+    # heavyweight imports live here so --smoke stays jax-free; the repo
+    # root goes on sys.path so `python tools/bench_autotune.py` works
+    # like the `-m tools.bench_autotune` form
+    if _ROOT not in sys.path:
+        sys.path.insert(0, _ROOT)
+    import jax
+    import numpy as np
+    import optax
+
+    from skycomputing_tpu.dynamics import (
+        Allocator,
+        ParameterServer,
+        WorkerManager,
+    )
+    from skycomputing_tpu.models import bert_config, bert_layer_configs
+    from skycomputing_tpu.ops import cross_entropy_loss
+    from skycomputing_tpu.parallel import PipelineModel
+    from skycomputing_tpu.runner import AutotuneHook, Runner
+    from skycomputing_tpu.telemetry import analysis as analysis_lib
+
+    devices = jax.devices()
+    n_workers = min(3, len(devices))
+    cfg = bert_config("tiny", dtype="float32", hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)
+    model_cfg = bert_layer_configs(cfg, num_encoder_units=3,
+                                   num_classes=3, deterministic=True)
+    wm = WorkerManager()
+    wm.load_worker_pool_from_config([
+        dict(name=f"n{i}", device_config=dict(device_index=i),
+             extra_config=dict(slowdown=3.0 if i == 0 else 1.0))
+        for i in range(n_workers)
+    ])
+
+    class _Dev:
+        def benchmark(self):
+            return {f"worker{w.rank}": dict(time=1.0, avai_mem=1e6)
+                    for w in wm.worker_pool}
+
+    class _Mod:
+        def benchmark(self):
+            return [1.0] * len(model_cfg), [0.1] * len(model_cfg)
+
+    allocator = Allocator(model_cfg, wm, _Mod(), _Dev())
+    allocator.even_allocate()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(5, 1024, size=(8, 16)).astype(np.int32)
+    types, mask = np.zeros_like(ids), np.ones_like(ids)
+    labels = rng.integers(0, 3, size=(8,)).astype(np.int32)
+    ps = ParameterServer(model_cfg, example_inputs=(ids, types, mask),
+                         rng=jax.random.key(0))
+    model = PipelineModel(wm, ps, optax.sgd(1e-2), cross_entropy_loss,
+                          devices=devices, num_microbatches=2)
+
+    class _Loader:
+        def __iter__(self):
+            while True:
+                yield (ids, types, mask), labels
+
+        def __len__(self):
+            return iters
+
+    hook = AutotuneHook(allocator=allocator, tune_every=6,
+                        solver_time_s=2.0)
+    runner = Runner(model, ps, wm, max_epochs=1, max_iters=iters)
+    runner.register_hook(hook)
+    runner.train(_Loader())
+
+    applied = [e for e in hook.events if e["outcome"] == "applied"]
+    committed = [e for e in hook.events if e["outcome"] == "committed"]
+    result = dict(
+        iters=iters,
+        partition=model.partition_signature(),
+        tunes=hook.tunes,
+        events=[{k: v for k, v in e.items() if k != "proposal"}
+                for e in hook.events],
+        step_ms=dict(
+            pre_tune=applied[0]["base_ms"] if applied else None,
+            post_tune=committed[-1]["new_ms"] if committed else None,
+        ),
+    )
+    print(json.dumps(result, indent=2, default=str))
+    if out:
+        payload = dict(bench="autotune", summary=dict(
+            step_ms=result["step_ms"]["post_tune"]
+            or result["step_ms"]["pre_tune"] or 0.0,
+        ), detail=result)
+        with open(out, "w") as fh:
+            json.dump(payload, fh, indent=2, default=str)
+        print(f"# wrote {out}")
+    # the analysis module is the same object the report CLI uses; keep
+    # the linkage visible in the artifact for provenance
+    print(f"# analysis library: {analysis_lib.__name__}")
+    return 0 if committed or not applied else 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--smoke", action="store_true",
+                        help="advisor-on-fixture structural check "
+                             "(pure stdlib, the CI invocation)")
+    parser.add_argument("--iters", type=int, default=30,
+                        help="training iterations for the full bench")
+    parser.add_argument("--out", help="write a BENCH-style JSON artifact")
+    args = parser.parse_args(argv)
+    if args.smoke:
+        return run_smoke()
+    return run_bench(args.iters, args.out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,20 +1,13 @@
 #!/usr/bin/env python
 """Trace-driven bubble/regression analysis over Chrome-trace timelines.
 
-Consumes a trace produced by ``skycomputing_tpu.telemetry`` (TraceHook
-for training, a tracing-enabled ``ServingEngine`` for serving) and
-computes the schedule-shape numbers the paper's headline claim is about:
-
-- **per-stage utilization** — busy fraction of each ``stage N`` lane
-  over the analysis window (PipeDream's per-stage occupancy method);
-- **bubble fraction** — ``1 - total_stage_busy / (num_stages x
-  window)``: the share of stage-seconds spent idle, the quantity the
-  balanced allocation exists to shrink;
-- **critical path** — the union of stage-busy intervals vs pure-stall
-  gaps where NO stage had work in flight;
-- **step times** — distribution over ``iter`` spans (TraceHook rows);
-- **serving breakdown** — prefill (the TTFT component) and decode (the
-  TPOT component) span distributions, admissions/preemptions/stalls.
+Thin CLI over the canonical analysis library,
+``skycomputing_tpu/telemetry/analysis.py`` — the same implementation the
+closed-loop autotuner (``skycomputing_tpu/tuning/``) consumes, so the
+numbers a human reads here are byte-identical to the numbers the tuner
+acts on.  See that module for the report schema (per-stage utilization,
+bubble fraction, critical path, step-time distribution, serving
+TTFT/TPOT components, per-bucket padding waste).
 
 Regression gate::
 
@@ -26,12 +19,17 @@ and exits **2** when the trace regresses beyond ``--tolerance`` (default
 10%) — turning the committed BENCH_*.json trajectory into an enforceable
 gate instead of an eyeballed one.
 
+``--json`` emits the full analysis dict as one JSON line on stdout —
+the machine-readable schema the tuner, CI, and external dashboards all
+consume; with ``--baseline`` the gate verdict rides along under a
+``baseline_gate`` key.
+
 ``--smoke`` runs the full analysis on the checked-in fixture trace
 (``tools/fixtures/trace_smoke.json``) and fails on any structural
 drift — the CI lint job runs it so this tool cannot silently rot.
 
-Pure stdlib (like ``tools/skylint.py``): runs on a bare CI runner with
-no jax install.
+Pure stdlib (like ``tools/skylint.py``): when the package import fails
+(no jax on a bare CI runner), the analysis library loads by file path.
 """
 
 from __future__ import annotations
@@ -39,310 +37,35 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import re
 import sys
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional
 
-STAGE_RE = re.compile(r"^stage\s+(\d+)")
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# baseline keys recognized by the regression gate, with the factor that
-# converts their value to milliseconds
-_STEP_KEYS_MS = {"step_ms": 1.0, "dispatch_ms": None, "step_wall_s": 1e3,
-                 "step_s": 1e3, "step_time_s": 1e3}
+# The analysis core is pure stdlib, but its package (`skycomputing_tpu`)
+# pulls in jax at import time.  Prefer the package import (one shared
+# module object with the tuner in a dev process); fall back to a
+# file-path load on runners with no accelerator stack installed.
+try:
+    from skycomputing_tpu.telemetry import analysis as _analysis
+except Exception:  # pragma: no cover - exercised on bare CI runners
+    import importlib.util
 
+    _spec = importlib.util.spec_from_file_location(
+        "skytpu_trace_analysis",
+        os.path.join(_ROOT, "skycomputing_tpu", "telemetry", "analysis.py"),
+    )
+    _analysis = importlib.util.module_from_spec(_spec)
+    sys.modules["skytpu_trace_analysis"] = _analysis
+    _spec.loader.exec_module(_analysis)
 
-class TraceError(Exception):
-    """Malformed or unanalyzable trace input."""
-
-
-# --------------------------------------------------------------------------
-# loading
-# --------------------------------------------------------------------------
-
-
-def load_events(path: str) -> List[Dict[str, Any]]:
-    """Events from a Chrome trace file (object form or bare array)."""
-    with open(path) as fh:
-        data = json.load(fh)
-    if isinstance(data, dict):
-        events = data.get("traceEvents")
-        if not isinstance(events, list):
-            raise TraceError(f"{path}: no traceEvents array")
-        return events
-    if isinstance(data, list):
-        return data
-    raise TraceError(f"{path}: expected trace object or event array")
-
-
-def lane_processes(events: List[Dict[str, Any]]) -> Dict[int, str]:
-    """pid -> process name, from "M" metadata events."""
-    out: Dict[int, str] = {}
-    for ev in events:
-        if ev.get("ph") == "M" and ev.get("name") == "process_name":
-            out[ev["pid"]] = ev.get("args", {}).get("name", str(ev["pid"]))
-    return out
-
-
-# --------------------------------------------------------------------------
-# interval math
-# --------------------------------------------------------------------------
-
-
-def merge_intervals(
-    intervals: List[Tuple[float, float]]
-) -> List[Tuple[float, float]]:
-    """Union of possibly-overlapping [t0, t1) intervals."""
-    merged: List[Tuple[float, float]] = []
-    for t0, t1 in sorted(intervals):
-        if merged and t0 <= merged[-1][1]:
-            merged[-1] = (merged[-1][0], max(merged[-1][1], t1))
-        else:
-            merged.append((t0, t1))
-    return merged
-
-
-def busy_us(intervals: List[Tuple[float, float]]) -> float:
-    return sum(t1 - t0 for t0, t1 in merge_intervals(intervals))
-
-
-def _pct(values: List[float], q: float) -> Optional[float]:
-    """Nearest-rank percentile, stdlib-only (no numpy on CI runners)."""
-    if not values:
-        return None
-    ordered = sorted(values)
-    rank = max(0, min(len(ordered) - 1,
-                      round(q / 100.0 * (len(ordered) - 1))))
-    return ordered[rank]
-
-
-# --------------------------------------------------------------------------
-# analysis
-# --------------------------------------------------------------------------
-
-
-def stage_spans(
-    events: List[Dict[str, Any]]
-) -> Dict[int, List[Tuple[float, float]]]:
-    """stage index -> list of (t0, t1) busy intervals from "X" events on
-    ``stage N`` lanes (fwd/bwd/update/prefill/decode alike — occupancy
-    is occupancy)."""
-    processes = lane_processes(events)
-    stage_pids: Dict[int, int] = {}
-    for pid, name in processes.items():
-        m = STAGE_RE.match(name)
-        if m:
-            stage_pids[pid] = int(m.group(1))
-    out: Dict[int, List[Tuple[float, float]]] = {}
-    for ev in events:
-        if ev.get("ph") != "X":
-            continue
-        stage = stage_pids.get(ev.get("pid"))
-        if stage is None:
-            continue
-        t0 = float(ev["ts"])
-        out.setdefault(stage, []).append((t0, t0 + float(ev.get("dur", 0))))
-    return out
-
-
-def named_durations(events: List[Dict[str, Any]], name: str) -> List[float]:
-    """Durations (us) of every "X" event with the given name."""
-    return [float(ev.get("dur", 0)) for ev in events
-            if ev.get("ph") == "X" and ev.get("name") == name]
-
-
-def count_instants(events: List[Dict[str, Any]], name: str) -> int:
-    return sum(1 for ev in events
-               if ev.get("ph") == "i" and ev.get("name") == name)
-
-
-def _clip(
-    intervals: List[Tuple[float, float]], lo: float, hi: float
-) -> List[Tuple[float, float]]:
-    return [(max(t0, lo), min(t1, hi))
-            for t0, t1 in intervals if t1 > lo and t0 < hi]
-
-
-def analyze(events: List[Dict[str, Any]]) -> Dict[str, Any]:
-    """The full report dict over one trace's events."""
-    spans = stage_spans(events)
-    if not spans:
-        raise TraceError(
-            "no stage lanes found (expected process names like "
-            "'stage 0 [device]' with X events)"
-        )
-    # the analysis window: iteration spans when the trace has them (they
-    # bound exactly the steady-state region someone gated on — a mid-run
-    # checkpoint or eval phase outside them must not count as bubble),
-    # otherwise the extent of stage activity
-    iter_spans = [
-        (float(ev["ts"]), float(ev["ts"]) + float(ev.get("dur", 0)))
-        for ev in events
-        if ev.get("ph") == "X" and ev.get("name") == "iter"
-    ]
-    iter_durs = [t1 - t0 for t0, t1 in iter_spans]
-    if iter_spans:
-        window = (min(t0 for t0, _ in iter_spans),
-                  max(t1 for _, t1 in iter_spans))
-        spans = {k: _clip(iv, *window) for k, iv in spans.items()}
-        spans = {k: iv for k, iv in spans.items() if iv}
-        if not spans:
-            raise TraceError("no stage activity inside the iter spans")
-    else:
-        all_points = [
-            t for iv in spans.values() for t01 in iv for t in t01
-        ]
-        window = (min(all_points), max(all_points))
-    window_us = window[1] - window[0]
-    if window_us <= 0:
-        raise TraceError("degenerate analysis window (no stage activity)")
-
-    stages = sorted(spans)
-    utilization = {
-        k: busy_us(spans[k]) / window_us for k in stages
-    }
-    total_busy = sum(busy_us(spans[k]) for k in stages)
-    bubble_fraction = 1.0 - total_busy / (len(stages) * window_us)
-    # critical path: time when AT LEAST one stage is busy; the remainder
-    # of the window is pure stall (host-only time — nothing in flight)
-    union = busy_us([iv for k in stages for iv in spans[k]])
-    report: Dict[str, Any] = {
-        "window_ms": window_us / 1e3,
-        "num_stages": len(stages),
-        "stage_utilization": {str(k): round(v, 4)
-                              for k, v in utilization.items()},
-        "bubble_fraction": round(bubble_fraction, 4),
-        "critical_path_ms": round(union / 1e3, 3),
-        "pure_stall_ms": round((window_us - union) / 1e3, 3),
-        "events": len(events),
-    }
-    if iter_durs:
-        report["steps"] = {
-            "count": len(iter_durs),
-            "mean_ms": round(sum(iter_durs) / len(iter_durs) / 1e3, 3),
-            "p50_ms": round(_pct(iter_durs, 50) / 1e3, 3),
-            "p95_ms": round(_pct(iter_durs, 95) / 1e3, 3),
-        }
-    # serving breakdown: prefill spans bound TTFT (admission -> first
-    # token), decode spans bound TPOT (one tick = one token for every
-    # active request)
-    prefill = named_durations(events, "prefill")
-    decode = named_durations(events, "decode")
-    serving_lanes = {
-        pid for pid, name in lane_processes(events).items()
-        if name == "serving"
-    }
-    if prefill or decode:
-        # engine-level spans only (per-stage prefill/decode spans share
-        # names; the engine lane carries the end-to-end figure)
-        eng_prefill = [float(ev["dur"]) for ev in events
-                       if ev.get("ph") == "X" and ev["name"] == "prefill"
-                       and ev.get("pid") in serving_lanes]
-        eng_decode = [float(ev["dur"]) for ev in events
-                      if ev.get("ph") == "X" and ev["name"] == "decode"
-                      and ev.get("pid") in serving_lanes]
-        prefill, decode = eng_prefill or prefill, eng_decode or decode
-        report["serving"] = {
-            "prefill_waves": len(prefill),
-            "decode_ticks": len(decode),
-            "ttft_component_p50_ms": round(
-                (_pct(prefill, 50) or 0.0) / 1e3, 3),
-            "ttft_component_p95_ms": round(
-                (_pct(prefill, 95) or 0.0) / 1e3, 3),
-            "tpot_component_p50_ms": round(
-                (_pct(decode, 50) or 0.0) / 1e3, 3),
-            "tpot_component_p95_ms": round(
-                (_pct(decode, 95) or 0.0) / 1e3, 3),
-            "admissions": count_instants(events, "admit"),
-            "preemptions": count_instants(events, "preempt"),
-            "queue_stalls": count_instants(events, "queue_stall"),
-        }
-    compiles = named_durations(events, "xla_compile")
-    report["xla_compiles"] = {
-        "count": len(compiles),
-        "total_ms": round(sum(compiles) / 1e3, 3),
-    }
-    report["transfers"] = {
-        "copies": count_instants(events, "transfer"),
-        "elided": count_instants(events, "transfer_elided"),
-    }
-    return report
-
-
-# --------------------------------------------------------------------------
-# regression gate
-# --------------------------------------------------------------------------
-
-
-def _walk_numeric(obj: Any, key_names, found: List[float]) -> None:
-    if isinstance(obj, dict):
-        for key, value in obj.items():
-            if key in key_names and isinstance(value, (int, float)):
-                found.append(float(value))
-            else:
-                _walk_numeric(value, key_names, found)
-    elif isinstance(obj, list):
-        for item in obj:
-            _walk_numeric(item, key_names, found)
-
-
-def baseline_targets(path: str) -> Dict[str, float]:
-    """Best step time (ms) and bubble fraction recorded in a BENCH json.
-
-    Committed BENCH_*.json artifacts nest their figures differently per
-    round, so extraction is by key name, recursively: the MINIMUM over
-    all ``step_ms``/``step_wall_s``/``step_s`` occurrences is the
-    trajectory's best step time — the gate's reference point.
-    """
-    with open(path) as fh:
-        data = json.load(fh)
-    out: Dict[str, float] = {}
-    steps: List[float] = []
-    for key, scale in _STEP_KEYS_MS.items():
-        if scale is None:
-            continue
-        found: List[float] = []
-        _walk_numeric(data, {key}, found)
-        steps.extend(v * scale for v in found)
-    positive = [v for v in steps if v > 0]
-    if positive:  # all-zero placeholders -> "no recognized keys" path
-        out["step_ms"] = min(positive)
-    bubbles: List[float] = []
-    _walk_numeric(data, {"bubble_fraction"}, bubbles)
-    if bubbles:
-        out["bubble_fraction"] = min(bubbles)
-    return out
-
-
-def check_regression(
-    report: Dict[str, Any], targets: Dict[str, float], tolerance: float
-) -> List[str]:
-    """Human-readable failure list (empty = within tolerance)."""
-    failures: List[str] = []
-    base_step = targets.get("step_ms")
-    if base_step is not None:
-        steps = report.get("steps")
-        if steps is None:
-            failures.append(
-                "baseline has a step time but the trace has no 'iter' "
-                "spans to compare (record with TraceHook)"
-            )
-        elif steps["p50_ms"] > base_step * (1.0 + tolerance):
-            failures.append(
-                f"step time regressed: trace p50 {steps['p50_ms']:.2f} ms "
-                f"> baseline {base_step:.2f} ms + {tolerance:.0%}"
-            )
-    base_bubble = targets.get("bubble_fraction")
-    if base_bubble is not None:
-        got = report["bubble_fraction"]
-        # absolute slack floor: a 0.02 -> 0.04 bubble move is noise on
-        # a near-perfect schedule, not a 2x regression
-        limit = max(base_bubble * (1.0 + tolerance), base_bubble + 0.02)
-        if got > limit:
-            failures.append(
-                f"bubble fraction regressed: trace {got:.4f} > baseline "
-                f"{base_bubble:.4f} (+{tolerance:.0%}, floor +0.02)"
-            )
-    return failures
+TraceError = _analysis.TraceError
+analyze = _analysis.analyze
+baseline_targets = _analysis.baseline_targets
+check_regression = _analysis.check_regression
+load_events = _analysis.load_events
+measured_stage_seconds = _analysis.measured_stage_seconds
+serving_padding_fraction = _analysis.serving_padding_fraction
 
 
 # --------------------------------------------------------------------------
@@ -360,7 +83,9 @@ def _print_human(report: Dict[str, Any]) -> None:
           f"{report['num_stages']} stages, {report['events']} events")
     for stage, util in sorted(report["stage_utilization"].items(),
                               key=lambda kv: int(kv[0])):
-        print(f"#   stage {stage}: utilization {float(util) * 100:5.1f}%")
+        busy = report["stage_busy_ms"].get(stage, 0.0)
+        print(f"#   stage {stage}: utilization {float(util) * 100:5.1f}% "
+              f"({busy:.2f} ms busy)")
     print(f"# bubble fraction {report['bubble_fraction'] * 100:.1f}% | "
           f"critical path {report['critical_path_ms']:.2f} ms | "
           f"pure stall {report['pure_stall_ms']:.2f} ms")
@@ -376,6 +101,9 @@ def _print_human(report: Dict[str, Any]) -> None:
               f"(TPOT p95 {s['tpot_component_p95_ms']:.2f} ms), "
               f"{s['admissions']} admits, {s['preemptions']} preempts, "
               f"{s['queue_stalls']} stalls")
+        padding = s.get("padding_fraction")
+        if padding is not None:
+            print(f"# serving prefill padding waste: {padding * 100:.1f}%")
     c = report["xla_compiles"]
     print(f"# xla compiles: {c['count']} ({c['total_ms']:.1f} ms) | "
           f"transfers {report['transfers']['copies']} copied, "
@@ -391,7 +119,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--tolerance", type=float, default=0.10,
                         help="allowed relative regression (default 0.10)")
     parser.add_argument("--json", action="store_true",
-                        help="emit the report as one JSON line")
+                        help="emit the full analysis dict as one JSON "
+                             "line (with --baseline, the gate verdict "
+                             "rides along under 'baseline_gate')")
     parser.add_argument("--smoke", action="store_true",
                         help="analyze the checked-in fixture trace and "
                              "verify the report's structure")
@@ -410,7 +140,29 @@ def main(argv: Optional[List[str]] = None) -> int:
               file=sys.stderr)
         return 1
 
+    failures: Optional[List[str]] = None
+    if args.baseline:
+        try:
+            targets = baseline_targets(args.baseline)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"trace_report: cannot read baseline "
+                  f"{args.baseline}: {exc}", file=sys.stderr)
+            return 1
+        if not targets:
+            print(f"trace_report: baseline {args.baseline} has no "
+                  f"recognized step/bubble keys", file=sys.stderr)
+            return 1
+        failures = check_regression(report, targets, args.tolerance)
+
     if args.json:
+        if failures is not None:
+            report = dict(report, baseline_gate={
+                "baseline": args.baseline,
+                "targets": targets,
+                "tolerance": args.tolerance,
+                "failures": failures,
+                "ok": not failures,
+            })
         print(json.dumps(report), flush=True)
     else:
         _print_human(report)
@@ -436,24 +188,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 1
         print("# smoke: ok")
 
-    if args.baseline:
-        try:
-            targets = baseline_targets(args.baseline)
-        except (OSError, json.JSONDecodeError) as exc:
-            print(f"trace_report: cannot read baseline "
-                  f"{args.baseline}: {exc}", file=sys.stderr)
-            return 1
-        if not targets:
-            print(f"trace_report: baseline {args.baseline} has no "
-                  f"recognized step/bubble keys", file=sys.stderr)
-            return 1
-        failures = check_regression(report, targets, args.tolerance)
+    if failures is not None:
         for failure in failures:
             print(f"REGRESSION: {failure}", file=sys.stderr)
         if failures:
             return 2
-        print(f"# baseline gate: ok (vs {args.baseline}, "
-              f"tolerance {args.tolerance:.0%})")
+        if not args.json:
+            print(f"# baseline gate: ok (vs {args.baseline}, "
+                  f"tolerance {args.tolerance:.0%})")
     return 0
 
 
