@@ -34,6 +34,7 @@ from .dynamics import (
     Worker,
     WorkerManager,
 )
+from .fleet import FleetSupervisor, Router, ServingFleet
 from .parallel import PipelineModel, StageRuntime
 from .runner import AutotuneHook, Hook, Runner
 from .serving import Request, ServingEngine
@@ -81,6 +82,9 @@ __all__ = [
     "AutotuneHook",
     "Request",
     "ServingEngine",
+    "ServingFleet",
+    "FleetSupervisor",
+    "Router",
     "ServingAutotuner",
     "TuningAdvisor",
     "Stimulator",

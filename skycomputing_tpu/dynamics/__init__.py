@@ -14,12 +14,17 @@ from .worker_manager import WorkerManager
 # imported last: faults.py reaches into ..runner for the Hook base, and
 # runner.runner imports the names above from this (then partially
 # initialized) module
-from .faults import FaultInjectionHook, FaultPlan  # noqa: E402
+from .faults import (  # noqa: E402
+    FaultInjectionHook,
+    FaultPlan,
+    FleetFaultInjector,
+)
 
 __all__ = [
     "Allocator",
     "FaultInjectionHook",
     "FaultPlan",
+    "FleetFaultInjector",
     "BaseBenchmarker",
     "DeviceBenchmarker",
     "ModelBenchmarker",

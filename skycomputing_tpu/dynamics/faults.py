@@ -27,8 +27,27 @@ Event kinds (each a plain dict, so plans serialize as JSON):
                a seeded fraction of its bytes — a torn write / partial
                upload as the newest artifact.
 
-All randomness (unspecified factors, truncation points) comes from one
-``numpy`` generator seeded at construction, so a plan replays exactly.
+Serving-fleet event kinds (applied by :class:`FleetFaultInjector` at
+fleet-TICK granularity — ``iter`` indexes ``ServingFleet.tick`` — so
+one vocabulary scripts chaos for trainer and fleet alike):
+
+``replica_crash``  the replica's engine stops responding: every
+               subsequent tick raises, heartbeats stop, and the fleet
+               supervisor must detect, migrate, and re-form.
+``latency_spike``  per-tick stall of ``seconds`` on one replica
+               (optional ``duration`` ticks; an unpinned ``seconds``
+               draws seeded) — a degraded-but-alive node, the
+               sick-replica detection target.
+``slot_leak``  leak ``count`` KV slots from the replica's pool (slots
+               allocated with no owning request) — partial capacity
+               loss, what a wedged worker or an accounting bug looks
+               like from the scheduler's seat.
+
+All randomness (unspecified factors, truncation points, spike lengths)
+comes from one ``numpy`` generator seeded at construction, so a plan
+replays exactly.  Each applier validates its vocabulary at
+construction: a trainer-only kind in a fleet plan (or vice versa) fails
+at build time, not 50 iterations into a chaos run.
 """
 
 from __future__ import annotations
@@ -43,7 +62,13 @@ from ..registry import HOOKS
 from ..runner.hooks import Hook
 from ..utils import Logger
 
-_KINDS = ("slowdown", "stall", "nan", "drop_beat", "corrupt_checkpoint")
+#: trainer-timeline kinds, applied by :class:`FaultInjectionHook`
+_TRAINER_KINDS = (
+    "slowdown", "stall", "nan", "drop_beat", "corrupt_checkpoint",
+)
+#: serving-fleet kinds, applied by :class:`FleetFaultInjector`
+_FLEET_KINDS = ("replica_crash", "latency_spike", "slot_leak")
+_KINDS = _TRAINER_KINDS + _FLEET_KINDS
 
 #: per-kind required event fields, validated at plan construction so a
 #: malformed plan fails at build time, not 50 iterations into a chaos run
@@ -53,6 +78,9 @@ _REQUIRED_FIELDS = {
     "nan": (),
     "drop_beat": (),
     "corrupt_checkpoint": ("path",),
+    "replica_crash": ("replica",),
+    "latency_spike": ("replica",),
+    "slot_leak": ("replica",),
 }
 
 
@@ -122,6 +150,13 @@ class FaultPlan:
         checkpoint corruption when the event doesn't pin one."""
         return float(lo + (hi - lo) * self._rng.random())
 
+    def draw_spike_seconds(self, lo: float = 0.02,
+                           hi: float = 0.2) -> float:
+        """One seeded draw for an unpinned ``latency_spike`` stall —
+        same generator as every other draw, so a plan that leaves
+        ``seconds`` open still replays byte-for-byte."""
+        return self.draw_fraction(lo, hi)
+
     def corrupt_checkpoint(
         self, path: str, keep_fraction: Optional[float] = None
     ) -> str:
@@ -165,6 +200,13 @@ class FaultInjectionHook(Hook):
     """
 
     def __init__(self, plan: FaultPlan, logger: Optional[Logger] = None):
+        foreign = [e for e in plan.events if e["kind"] in _FLEET_KINDS]
+        if foreign:
+            raise ValueError(
+                f"FaultInjectionHook applies trainer-timeline faults "
+                f"only; fleet kinds {sorted({e['kind'] for e in foreign})}"
+                f" belong in a FleetFaultInjector plan"
+            )
         self._plan = plan
         self._logger = logger or Logger()
         # worker stim_index -> (clear_at_iter, previous_factor)
@@ -308,4 +350,90 @@ class FaultInjectionHook(Hook):
             self._pending_stall_s = 0.0
 
 
-__all__ = ["FaultPlan", "FaultInjectionHook"]
+class FleetFaultInjector:
+    """Apply a :class:`FaultPlan`'s fleet vocabulary to a serving fleet.
+
+    The fleet twin of :class:`FaultInjectionHook`: the fleet calls
+    :meth:`on_tick` at the START of every :meth:`ServingFleet.step`
+    (before any replica runs and before the supervisor observes), so an
+    event at tick N is in place when tick N's detection looks.  The
+    target is duck-typed — anything with ``tick`` and
+    ``replica_by_index(i)`` returning objects exposing ``crash()`` /
+    ``inject_stall(seconds, duration_ticks)`` / ``leak_slots(count)``
+    (:class:`~..fleet.replica.EngineReplica`'s fault surface) — which
+    keeps dynamics -> fleet import-free.
+
+    ``applied`` records every fired event with the tick it fired at and
+    any seeded draw it consumed, for test assertions.
+    """
+
+    def __init__(self, plan: FaultPlan, logger: Optional[Logger] = None):
+        foreign = [e for e in plan.events
+                   if e["kind"] not in _FLEET_KINDS]
+        if foreign:
+            raise ValueError(
+                f"FleetFaultInjector applies fleet faults only; trainer "
+                f"kinds {sorted({e['kind'] for e in foreign})} belong in "
+                f"a FaultInjectionHook plan"
+            )
+        self._plan = plan
+        self._logger = logger or Logger()
+        self.applied: List[Dict[str, Any]] = []
+        self._validated = False
+
+    def on_tick(self, fleet) -> None:
+        if not self._validated:
+            # the fleet is first available HERE, so replica indices are
+            # range-checked on the first tick — before anything fires —
+            # keeping the fails-at-arm-time contract the kind/field
+            # validation makes at construction
+            self._validated = True
+            n = len(fleet.replicas)
+            bad = sorted({
+                int(e["replica"]) for e in self._plan.events
+                if not 0 <= int(e["replica"]) < n
+            })
+            if bad:
+                raise ValueError(
+                    f"fault plan names replica indices {bad} but the "
+                    f"fleet has {n} replicas"
+                )
+        for ev in self._plan.events_at(fleet.tick):
+            kind = ev["kind"]
+            replica = fleet.replica_by_index(int(ev["replica"]))
+            if kind == "replica_crash":
+                replica.crash()
+                self._logger.info(
+                    f"FAULT tick {fleet.tick}: replica {replica.name} "
+                    f"crashed"
+                )
+            elif kind == "latency_spike":
+                seconds = ev.get("seconds")
+                if seconds is None:
+                    seconds = self._plan.draw_spike_seconds()
+                    ev = dict(ev, seconds=float(seconds))
+                duration = ev.get("duration")
+                replica.inject_stall(
+                    float(seconds),
+                    None if duration is None
+                    else fleet.tick + int(duration),
+                )
+                self._logger.info(
+                    f"FAULT tick {fleet.tick}: replica {replica.name} "
+                    f"latency spike {float(seconds):.3f}s/tick"
+                    + (f" for {duration} ticks" if duration else "")
+                )
+            elif kind == "slot_leak":
+                want = int(ev.get("count", 1))
+                leaked = replica.leak_slots(want)
+                if leaked < want:
+                    # an exhausted pool leaks fewer — record the truth
+                    ev = dict(ev, leaked=leaked)
+                self._logger.info(
+                    f"FAULT tick {fleet.tick}: replica {replica.name} "
+                    f"leaked {leaked} slot(s)"
+                )
+            self.applied.append(dict(ev, fired_at=fleet.tick))
+
+
+__all__ = ["FaultPlan", "FaultInjectionHook", "FleetFaultInjector"]

@@ -1,0 +1,563 @@
+"""ServingFleet: N replicated engines behind SLO-aware admission.
+
+One :class:`~..serving.ServingEngine` is one pipeline; the fleet is the
+layer the ROADMAP's "millions of users" north star actually needs:
+
+- **replication** — N engine replicas, each built through the same
+  allocator/worker-manager path (and the same serving pre-flight) a
+  single engine uses; Orca's iteration-level scheduling stays strictly
+  per-replica, so this layer never reaches into an engine's tick.
+- **routing** — :class:`~.router.Router` least-loaded + prefix-affinity
+  dispatch over live replica snapshots (queue depth, free slots,
+  TTFT/TPOT percentiles — the ``MetricsRegistry`` surface).
+- **admission control** — :class:`~.admission.AdmissionController`
+  bounded intake with priority classes, deadline-aware rejects, and
+  ``Retry-After``-style hints; rejects are counted per reason, never
+  silent.
+- **self-heal** — :class:`~.supervisor.FleetSupervisor` detects sick or
+  dead replicas (heartbeat + EWMA health score), drains them through
+  the engine ``preempt`` contract, re-queues the work
+  recomputation-style onto survivors (token streams provably intact —
+  the ``Request`` object carries its committed tokens, so a migrated
+  request resumes exactly), and re-forms the lost replica through its
+  original verified builder.
+
+The fleet loop is synchronous and single-threaded (the single-
+controller design this repo runs everywhere): ``step()`` ticks every
+healthy replica once, then lets the supervisor look.  Determinism is
+the point — a seeded :class:`~..dynamics.faults.FleetFaultInjector`
+plan replays a replica crash byte-for-byte, which is what makes the
+chaos suite a real gate instead of a flake generator.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..serving.batcher import FAILED, FINISHED, QueueFullError, REJECTED, Request
+from ..serving.engine import ServingEngine
+from ..telemetry import MetricsRegistry, get_tracer
+from ..utils import Logger
+from ..utils.retry import retry_call
+from .admission import (
+    AdmissionController,
+    AdmitDecision,
+    BATCH,
+    REPLICAS_SATURATED,
+)
+from .replica import (
+    DRAINING,
+    EngineReplica,
+    HEALTHY,
+    RETIRED,
+    ReplicaCrashed,
+)
+from .router import Router
+from .supervisor import FleetSupervisor
+
+
+@dataclass
+class FleetStats:
+    """Fleet-level accounting (the ``ServingStats`` of the fleet layer).
+
+    Per-replica serving counters stay on each replica's own
+    ``ServingStats``; this records what only the fleet can see —
+    admission outcomes, migrations, re-forms, failures.  Every request
+    turned away or lost increments a counter here: degradation is only
+    acceptable when it is visible.
+    """
+
+    submitted: int = 0
+    admitted: int = 0
+    dispatched: int = 0
+    rejected: int = 0
+    rejected_by_reason: Dict[str, int] = field(default_factory=dict)
+    migrations: int = 0
+    failed: int = 0
+    reforms: int = 0
+    reform_failures: int = 0
+    missed_beats: int = 0
+    ticks: int = 0
+    # gauges (last step)
+    replicas_healthy: int = 0
+    pending: int = 0
+    limbo_depth: int = 0
+
+    def count_rejection(self, reason: str) -> None:
+        self.rejected += 1
+        self.rejected_by_reason[reason] = (
+            self.rejected_by_reason.get(reason, 0) + 1
+        )
+
+    def snapshot(self) -> Dict[str, Any]:
+        return dict(
+            submitted=self.submitted,
+            admitted=self.admitted,
+            dispatched=self.dispatched,
+            rejected=self.rejected,
+            rejected_by_reason=dict(self.rejected_by_reason),
+            migrations=self.migrations,
+            failed=self.failed,
+            reforms=self.reforms,
+            reform_failures=self.reform_failures,
+            missed_beats=self.missed_beats,
+            ticks=self.ticks,
+            replicas_healthy=self.replicas_healthy,
+            pending=self.pending,
+            limbo_depth=self.limbo_depth,
+        )
+
+
+class ServingFleet:
+    """N serving-engine replicas behind routing, admission, self-heal.
+
+    ``model_cfg``/``params_list`` are the standard layer-config list and
+    per-layer param trees every engine shares (replicas serve the same
+    model; params are committed per replica device by each engine's own
+    constructor).  ``replica_specs`` gives each replica its placement —
+    any ``ServingEngine`` kwargs (``partition``/``devices``/
+    ``worker_manager``...) — while ``engine_kwargs`` carries the shared
+    operating point (slots, buckets, ``max_queue``...).  Default: one
+    single-stage replica per fake/real device, round-robin.
+    """
+
+    def __init__(
+        self,
+        model_cfg: Sequence[Dict],
+        params_list: Sequence[Any],
+        *,
+        replicas: int = 2,
+        replica_specs: Optional[Sequence[Dict[str, Any]]] = None,
+        engine_kwargs: Optional[Dict[str, Any]] = None,
+        router: Optional[Router] = None,
+        admission: Optional[AdmissionController] = None,
+        supervisor: Optional[FleetSupervisor] = None,
+        fault_injector=None,
+        devices: Optional[Sequence[Any]] = None,
+        finished_history: int = 4096,
+        slo_window: int = 2048,
+        logger: Optional[Logger] = None,
+    ):
+        self._logger = logger or Logger()
+        self.router = router or Router()
+        self.admission = admission or AdmissionController()
+        self.supervisor = supervisor or FleetSupervisor(
+            logger=self._logger
+        )
+        self.fault_injector = fault_injector
+        self.stats = FleetStats()
+        shared = dict(engine_kwargs or {})
+        if replica_specs is None:
+            devs = list(devices) if devices is not None else jax.devices()
+            replica_specs = [
+                dict(devices=[devs[i % len(devs)]])
+                for i in range(int(replicas))
+            ]
+        if not replica_specs:
+            raise ValueError("a fleet needs at least one replica")
+
+        def make_builder(spec: Dict[str, Any]):
+            merged = dict(shared)
+            merged.update(spec)
+
+            def build() -> ServingEngine:
+                return ServingEngine(model_cfg, params_list, **merged)
+
+            return build
+
+        self.replicas: List[EngineReplica] = [
+            EngineReplica(f"replica{i}", make_builder(spec))
+            for i, spec in enumerate(replica_specs)
+        ]
+        self._by_name = {r.name: r for r in self.replicas}
+        self.tick = 0
+        # fleet ledger: every admitted, unfinished request — the source
+        # of truth a dead replica's recovery reads (Request objects
+        # carry their committed tokens, so nothing dies with an engine)
+        self._pending: Dict[int, Request] = {}
+        self._assignment: Dict[int, str] = {}
+        # bounded: a fleet sized for "millions of users" must not grow
+        # its ledgers with lifetime traffic.  _finished is a recency
+        # history (insertion-ordered, oldest evicted past the cap); the
+        # SLO windows are rolling samples the percentiles read in O(w)
+        # instead of walking every request ever served.
+        self._finished: Dict[int, Request] = {}
+        self._finished_limit = max(int(finished_history), 1)
+        self._ttft_window: deque = deque(maxlen=max(int(slo_window), 1))
+        self._tpot_window: deque = deque(maxlen=max(int(slo_window), 1))
+        # run()'s output collector: filled incrementally at finish time,
+        # so history eviction can never lose a return value mid-call
+        self._collector: Optional[Dict[int, Request]] = None
+        # migration limbo: drained requests no survivor could hold yet;
+        # re-dispatched at the start of every step
+        self._limbo: List[Request] = []
+        # one registry over the whole fleet: the "fleet" source plus one
+        # serving source per replica (same poller reads everything)
+        self.metrics = MetricsRegistry()
+        self.metrics.register("fleet", self._fleet_snapshot)
+        for rep in self.replicas:
+            self.metrics.register(
+                rep.name,
+                (lambda r=rep: r.engine.stats.snapshot()),
+            )
+
+    # --- views --------------------------------------------------------------
+    def replica_by_index(self, index: int) -> EngineReplica:
+        return self.replicas[index]
+
+    def replica_snapshots(self) -> List[Dict[str, Any]]:
+        return [r.snapshot() for r in self.replicas]
+
+    @property
+    def healthy_replicas(self) -> List[EngineReplica]:
+        return [r for r in self.replicas
+                if r.state == HEALTHY and not r.crashed]
+
+    def _capacity_slots(self) -> int:
+        return sum(r.engine.num_slots for r in self.healthy_replicas)
+
+    def _pending_depth(self) -> int:
+        depth = sum(
+            r.engine.stats.queue_depth for r in self.healthy_replicas
+        )
+        return depth + len(self._limbo)
+
+    @staticmethod
+    def _window_percentile(window: deque, q: float) -> Optional[float]:
+        if not window:
+            return None
+        return float(np.percentile(list(window), q))
+
+    def _fleet_snapshot(self) -> Dict[str, Any]:
+        snap = self.stats.snapshot()
+        snap.update(
+            ttft_p50_s=self._window_percentile(self._ttft_window, 50),
+            ttft_p95_s=self._window_percentile(self._ttft_window, 95),
+            tpot_p50_s=self._window_percentile(self._tpot_window, 50),
+            tpot_p95_s=self._window_percentile(self._tpot_window, 95),
+        )
+        return snap
+
+    # --- admission + dispatch ----------------------------------------------
+    def submit(self, request: Request, *, priority: str = BATCH,
+               deadline_s: Optional[float] = None) -> AdmitDecision:
+        """Admit-or-shed, then route.  Returns the decision either way
+        — a reject carries the reason and a ``Retry-After``-style hint
+        and marks the request ``REJECTED``; an accept carries the
+        replica it landed on."""
+        self.stats.submitted += 1
+        tracer = get_tracer()
+        decision = self.admission.decide(
+            pending=self._pending_depth(),
+            capacity_slots=self._capacity_slots(),
+            priority=priority,
+            deadline_s=deadline_s,
+            tpot_p50_s=self._window_percentile(self._tpot_window, 50),
+        )
+        if not decision.admitted:
+            self._reject(request, decision, tracer)
+            return decision
+        # snapshots only after the admission gate: a rejected request
+        # must not pay the per-replica snapshot walk for nothing
+        snaps = self.replica_snapshots()
+        try:
+            name = self._dispatch(request, snaps, deadline_s)
+        except QueueFullError as exc:
+            decision = AdmitDecision(
+                False, reason=REPLICAS_SATURATED,
+                retry_after_s=self.admission.estimate_wait_s(
+                    exc.queue_depth + 1, max(self._capacity_slots(), 1),
+                    self._window_percentile(self._tpot_window, 50),
+                ),
+                detail=dict(queue_depth=exc.queue_depth),
+            )
+            self._reject(request, decision, tracer)
+            return decision
+        self.stats.admitted += 1
+        self.stats.dispatched += 1
+        self._pending[request.request_id] = request
+        self._assignment[request.request_id] = name
+        if tracer is not None:
+            tracer.instant(
+                "dispatch", tracer.lane("fleet", "router"),
+                {"request": request.request_id, "replica": name,
+                 "priority": priority},
+            )
+        return AdmitDecision(True, replica=name,
+                             detail=decision.detail)
+
+    def _reject(self, request: Request, decision: AdmitDecision,
+                tracer) -> None:
+        request.status = REJECTED
+        self.stats.count_rejection(decision.reason)
+        if tracer is not None:
+            tracer.instant(
+                "reject", tracer.lane("fleet", "admission"),
+                {"request": request.request_id,
+                 "reason": decision.reason,
+                 "retry_after_s": decision.retry_after_s},
+            )
+
+    def _dispatch(self, request: Request,
+                  snaps: Sequence[Dict[str, Any]],
+                  deadline_s: Optional[float]) -> str:
+        """Walk the router's ranking until a replica's bounded queue
+        accepts, under the caller's total deadline (the ``retry_call``
+        budget): a saturated-or-dying fleet must give up within the
+        request's patience, not after an unbounded crawl."""
+        ranked = self.router.rank(snaps, prompt=request.prompt)
+        if not ranked:  # admission already gates on capacity; belt+braces
+            raise QueueFullError("no healthy replica", 0)
+        candidates = list(ranked)
+
+        def attempt() -> str:
+            name = candidates.pop(0)
+            self._by_name[name].engine.submit(request)
+            return name
+
+        name = retry_call(
+            attempt,
+            attempts=len(candidates),
+            retry_on=(QueueFullError,),
+            base_delay_s=0.0, jitter=0.0, seed=0,
+            deadline_s=deadline_s,
+        )
+        self.router.record_dispatch(name, request.prompt)
+        return name
+
+    # --- drain / migrate (called by the supervisor) -------------------------
+    def drain_replica(self, replica: EngineReplica,
+                      dead: bool) -> List[Request]:
+        """Everything in flight on ``replica``, token streams intact.
+
+        Sick (alive) replicas drain gracefully through the engine's
+        ``preempt`` contract; a still-running request the engine could
+        not preempt (resume prefix outgrew every bucket) stays on the
+        engine, and the supervisor parks the replica DRAINING until it
+        finishes — alive is alive.  A dead replica's engine state is
+        treated as unreachable; its requests come from the fleet ledger
+        — reset to queued with their committed tokens in place, the
+        recomputation-resume invariant — and a non-resumable request
+        there is FAILED by redispatch, visibly.
+        """
+        if not dead:
+            return replica.engine.drain()
+        migrated: List[Request] = []
+        for rid, name in list(self._assignment.items()):
+            if name != replica.name:
+                continue
+            # un-assign NOW: a collected request that later parks in
+            # limbo must not keep pointing at this replica, or a second
+            # death of the (re-formed) replica would collect it again
+            # and double-queue the same token stream
+            self._assignment.pop(rid)
+            r = self._pending.get(rid)
+            if r is None or r.status == FINISHED or r.done:
+                continue
+            r.slot = None
+            # an involuntary eviction IS a preemption — honest per-
+            # request accounting, and the marker that shields a not-yet-
+            # started migrant from ever being a shed victim downstream
+            r.preemptions += 1
+            migrated.append(r)
+        return migrated
+
+    def redispatch(self, requests: Sequence[Request]) -> Tuple[int, int]:
+        """Place migrated requests on survivors; (placed, parked).
+
+        Placement is FORCED (already-admitted requests are never
+        re-judged by a survivor's bound); a request parks in limbo only
+        while NO healthy replica exists, retrying every step, and one
+        no replica can EVER hold (bucket infeasibility everywhere)
+        fails visibly."""
+        placed = parked = 0
+        for r in requests:
+            if r.status == FINISHED or r.done:
+                continue
+            outcome = self._redispatch_one(r)
+            if outcome == "placed":
+                placed += 1
+            elif outcome == "parked":
+                parked += 1
+        self.stats.limbo_depth = len(self._limbo)
+        return placed, parked
+
+    def _redispatch_one(self, request: Request) -> str:
+        snaps = self.replica_snapshots()
+        ranked = self.router.rank(snaps, prompt=request.prompt)
+        infeasible = 0
+        for name in ranked:
+            rep = self._by_name[name]
+            try:
+                # force: this request was already admitted — the fleet's
+                # promise survives the replica it was first placed on,
+                # so the survivor's bound/shed policy does not re-judge
+                # it (transient overshoot is bounded by the dead
+                # replica's former load)
+                rep.engine.submit(request, force=True)
+            except ValueError:
+                infeasible += 1
+                continue
+            self._assignment[request.request_id] = name
+            self.stats.migrations += 1
+            self.router.record_dispatch(name, request.prompt)
+            return "placed"
+        if ranked and infeasible == len(ranked):
+            self._fail(request,
+                       "no replica's bucket set fits the resume prefix")
+            return "failed"
+        # parked requests are owned by the fleet, not any replica: a
+        # stale assignment here would let a dead-drain collect the same
+        # request a second time
+        self._assignment.pop(request.request_id, None)
+        self._limbo.append(request)
+        return "parked"
+
+    def _fail(self, request: Request, why: str) -> None:
+        request.status = FAILED
+        self._pending.pop(request.request_id, None)
+        self._assignment.pop(request.request_id, None)
+        self.stats.failed += 1
+        self._logger.warning(
+            f"ServingFleet: request {request.request_id} failed: {why}"
+        )
+        tracer = get_tracer()
+        if tracer is not None:
+            tracer.instant(
+                "request_failed", tracer.lane("fleet", "supervisor"),
+                {"request": request.request_id, "why": why},
+            )
+
+    # --- the fleet loop -----------------------------------------------------
+    def has_work(self) -> bool:
+        return bool(self._pending) or bool(self._limbo)
+
+    def step(self) -> None:
+        """One fleet iteration: inject scheduled faults, retry limbo,
+        tick every healthy replica, then let the supervisor look."""
+        if self.fault_injector is not None:
+            self.fault_injector.on_tick(self)
+        if self._limbo:
+            limbo, self._limbo = self._limbo, []
+            self.redispatch(limbo)
+        for replica in self.replicas:
+            # DRAINING replicas still tick: they are finishing requests
+            # that cannot migrate — out of rotation, not out of work
+            if replica.state not in (HEALTHY, DRAINING):
+                continue
+            stats0 = replica.engine.stats
+            compiles0 = stats0.compiles
+            waves0 = stats0.prefill_waves
+            decoded0 = stats0.decode_tokens
+            t0 = time.perf_counter()
+            try:
+                replica.tick(self.tick)
+            except ReplicaCrashed:
+                replica.missed_beats += 1
+                self.stats.missed_beats += 1
+                continue
+            # honest compute timing: tick() blocks on the engine's own
+            # device sync before returning
+            tick_s = time.perf_counter() - t0
+            stats = replica.engine.stats
+            if (replica.state == HEALTHY
+                    and stats.compiles == compiles0
+                    and stats.prefill_waves == waves0
+                    and stats.decode_tokens > decoded0):
+                # the health probe is the PURE-DECODE tick: decode is
+                # fixed-shape ([slots, 1] against the slab), so its wall
+                # time is workload-independent and comparable across the
+                # replica's whole life.  Ticks that compiled (bucket
+                # warmup — e.g. right after a migration re-buckets),
+                # ran a prefill wave (cost scales with the wave, not the
+                # host's health), or did nothing would all poison the
+                # EWMA baseline and let the fleet's own admission
+                # rhythm read as a straggler.
+                self.supervisor.observe_tick(replica, tick_s)
+        self.supervisor.poll(self)
+        self._sweep_terminal()
+        self.stats.ticks += 1
+        self.stats.replicas_healthy = len(self.healthy_replicas)
+        self.stats.pending = len(self._pending)
+        self.stats.limbo_depth = len(self._limbo)
+        self.tick += 1
+
+    def _sweep_terminal(self) -> None:
+        """Move finished requests to the fleet ledger's done side, and
+        account engine-level sheds (a replica's bounded queue displaced
+        a fleet-dispatched request) as fleet rejections."""
+        for rid, r in list(self._pending.items()):
+            if r.status == FINISHED:
+                self._finished[rid] = self._pending.pop(rid)
+                self._assignment.pop(rid, None)
+                if self._collector is not None:
+                    self._collector[rid] = r
+                ttft, tpot = r.ttft_s(), r.tpot_s()
+                if ttft is not None:
+                    self._ttft_window.append(ttft)
+                if tpot is not None:
+                    self._tpot_window.append(tpot)
+                while len(self._finished) > self._finished_limit:
+                    oldest = next(iter(self._finished))
+                    del self._finished[oldest]
+            elif r.status == REJECTED:
+                self._pending.pop(rid)
+                self._assignment.pop(rid, None)
+                self.stats.count_rejection("engine_shed")
+            elif r.status == FAILED:
+                self._pending.pop(rid, None)
+                self._assignment.pop(rid, None)
+        # nobody left to serve and nobody coming back: fail limbo
+        # loudly instead of spinning forever
+        if self._limbo and all(r.state == RETIRED
+                               for r in self.replicas):
+            for r in self._limbo:
+                self._fail(r, "every replica is retired")
+            self._limbo = []
+
+    def run(
+        self,
+        requests: Optional[Sequence[Request]] = None,
+        *,
+        priority: str = BATCH,
+        max_ticks: int = 100_000,
+    ) -> Dict[int, np.ndarray]:
+        """Submit ``requests`` and drive ``step`` until the fleet
+        drains; returns ``{request_id: prompt + generated tokens}`` for
+        everything that finished during the call (rejected/failed
+        requests are visible on their ``status`` and in ``stats``).
+        Outputs are collected incrementally at finish time, so the
+        bounded finished-history eviction can never lose one mid-call."""
+        collector: Dict[int, Request] = {}
+        self._collector = collector
+        try:
+            for r in requests or ():
+                self.submit(r, priority=priority)
+            for _ in range(max_ticks):
+                if not self.has_work():
+                    break
+                self.step()
+            else:  # pragma: no cover - scheduler liveness guard
+                raise RuntimeError(
+                    f"serving fleet did not drain in {max_ticks} ticks "
+                    f"(pending={len(self._pending)}, "
+                    f"limbo={len(self._limbo)})"
+                )
+        finally:
+            self._collector = None
+        return {rid: r.output() for rid, r in collector.items()}
+
+    @property
+    def finished_requests(self) -> List[Request]:
+        """The most recent finished requests (bounded recency history —
+        ``finished_history`` — not lifetime traffic)."""
+        return list(self._finished.values())
+
+
+__all__ = ["FleetStats", "ServingFleet"]

@@ -204,9 +204,13 @@ class FileRendezvous:
                 return json.load(fh)
 
         try:
+            # deadline: the payload read happens inside a formation
+            # round, and its worst-case backoff (~5 s) must never eat a
+            # short formation window on its own
             payload = retry_call(
                 read_payload, retry_on=(OSError, json.JSONDecodeError),
-                attempts=4, logger=self._logger, describe=f"read {path}",
+                attempts=4, deadline_s=self.timeout_s,
+                logger=self._logger, describe=f"read {path}",
             )
         except json.JSONDecodeError as exc:
             self._logger.info(f"discarding corrupt realloc payload: {exc}")
@@ -310,6 +314,10 @@ class FileRendezvous:
                     read_spec,
                     retry_on=(OSError, json.JSONDecodeError),
                     attempts=4,
+                    # the read's retry budget is whatever is left of THIS
+                    # formation round: backing off past the formation
+                    # deadline would convert a transient into a timeout
+                    deadline_s=max(0.0, deadline - time.monotonic()),
                     logger=self._logger,
                     describe=f"read {path}",
                 )

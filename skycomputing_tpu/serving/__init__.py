@@ -25,7 +25,12 @@ package can import its submodules eagerly without a cycle.)
 
 from __future__ import annotations
 
-from .batcher import AdmissionQueue, Request, ShapeBucketer
+from .batcher import (
+    AdmissionQueue,
+    QueueFullError,
+    Request,
+    ShapeBucketer,
+)
 from .engine import ServingEngine, ServingStats
 from .kv_cache import (
     KVCacheSpec,
@@ -41,6 +46,7 @@ __all__ = [
     "AdmissionQueue",
     "DecodeModelBenchmarker",
     "KVCacheSpec",
+    "QueueFullError",
     "Request",
     "ServingEngine",
     "ServingStats",

@@ -28,8 +28,27 @@ import numpy as np
 QUEUED = "queued"
 RUNNING = "running"
 FINISHED = "finished"
+# terminal non-success states (set by admission control / the fleet):
+# REJECTED = refused or shed by a bounded queue / load-shedding policy,
+# FAILED = lost to an unrecoverable replica failure (a request whose
+# resume prefix outgrew every bucket on a dead engine) — both always
+# counted, never silent
+REJECTED = "rejected"
+FAILED = "failed"
 
 _REQUEST_IDS = itertools.count()
+
+
+class QueueFullError(RuntimeError):
+    """A bounded :class:`AdmissionQueue` refused a new submission.
+
+    Carries ``queue_depth`` (the bound it hit) so callers can build an
+    honest backpressure hint (Retry-After-style) instead of guessing.
+    """
+
+    def __init__(self, message: str, queue_depth: int = 0):
+        super().__init__(message)
+        self.queue_depth = int(queue_depth)
 
 
 @dataclass
@@ -161,15 +180,28 @@ class ShapeBucketer:
 
 
 class AdmissionQueue:
-    """FIFO queue with same-bucket packing for prefill waves."""
+    """FIFO queue with same-bucket packing for prefill waves.
 
-    def __init__(self, bucketer: ShapeBucketer, prefill_batch: int = 1):
+    ``max_queue`` bounds the depth: a full queue REJECTS new submissions
+    with :class:`QueueFullError` instead of growing without bound (an
+    unbounded admission queue under a traffic spike is an OOM with extra
+    steps).  The bound applies to NEW admissions only — re-queues that
+    preserve an already-admitted request's token stream (preemption,
+    reconfiguration, fleet migration) pass ``force=True`` and always
+    land, because dropping one of those silently loses committed tokens.
+    """
+
+    def __init__(self, bucketer: ShapeBucketer, prefill_batch: int = 1,
+                 max_queue: Optional[int] = None):
         if prefill_batch < 1:
             raise ValueError(
                 f"prefill_batch must be >= 1, got {prefill_batch}"
             )
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.bucketer = bucketer
         self.prefill_batch = int(prefill_batch)
+        self.max_queue = None if max_queue is None else int(max_queue)
         self._queue: List[Request] = []
 
     def __len__(self) -> int:
@@ -179,14 +211,42 @@ class AdmissionQueue:
     def depth(self) -> int:
         return len(self._queue)
 
-    def submit(self, request: Request) -> None:
+    def submit(self, request: Request, force: bool = False) -> None:
+        # bucket validation FIRST (its ValueError is the older contract
+        # and callers match on it), capacity second, state mutation last
+        # — a rejected request keeps its pre-submit state
+        bucket = self.bucketer.bucket_for(
+            int(request.effective_prompt.size)
+        )
+        if (not force and self.max_queue is not None
+                and len(self._queue) >= self.max_queue):
+            raise QueueFullError(
+                f"admission queue full ({len(self._queue)}/"
+                f"{self.max_queue}); request {request.request_id} "
+                f"rejected", queue_depth=len(self._queue),
+            )
         if request.submitted_s is None:
             request.submitted_s = time.perf_counter()
         request.status = QUEUED
-        request.bucket = self.bucketer.bucket_for(
-            int(request.effective_prompt.size)
-        )
+        request.bucket = bucket
         self._queue.append(request)
+
+    def shed_oldest(self) -> Optional[Request]:
+        """Remove and return the oldest SHEDDABLE queued request (the
+        shed policy's victim: under overload the head of the queue has
+        waited longest and is the most likely to have already blown its
+        deadline), or None when nothing can be shed.  A request with
+        committed tokens (a preempted/migrated resume, force-queued) is
+        never a victim — shedding it would lose its generated stream,
+        the exact outcome ``force`` exists to prevent — and neither is
+        a preempted/migrated request still waiting for its first token
+        (``preemptions > 0``): its admission promise was already made
+        once and must not be revoked to seat a newcomer.  The caller
+        owns marking the victim ``REJECTED`` and counting the shed."""
+        for i, r in enumerate(self._queue):
+            if not r.tokens and r.preemptions == 0:
+                return self._queue.pop(i)
+        return None
 
     @property
     def requests(self) -> Tuple[Request, ...]:
@@ -227,8 +287,11 @@ class AdmissionQueue:
 
 __all__ = [
     "AdmissionQueue",
+    "FAILED",
     "FINISHED",
     "QUEUED",
+    "QueueFullError",
+    "REJECTED",
     "RUNNING",
     "Request",
     "ShapeBucketer",

@@ -37,6 +37,7 @@ batch_occupancy`` makes it visible instead of hidden.
 
 from __future__ import annotations
 
+import json
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
@@ -62,6 +63,8 @@ from ..telemetry import MetricsRegistry, get_tracer
 from .batcher import (
     AdmissionQueue,
     FINISHED,
+    QueueFullError,
+    REJECTED,
     RUNNING,
     Request,
     ShapeBucketer,
@@ -77,6 +80,20 @@ _gather_last = jax.jit(
 _argmax_tokens = jax.jit(
     lambda logits: jnp.argmax(logits, axis=-1).astype(jnp.int32)
 )
+
+# Process-level stage-program cache: the jit'd decode/prefill closures,
+# keyed by the stage's layer-config signature (+ max_len + donation).
+# jax's compilation cache is keyed by FUNCTION IDENTITY, so two engines
+# built from identical configs would otherwise re-trace and re-compile
+# every program — which makes a fleet replica's re-form pay the full
+# compile bill on the serving path.  Reusing the closure lets a
+# re-formed replica (and every same-config engine in tests/benches)
+# restart at cache-hit speed, the serving twin of the training side's
+# persistent-compile-cache-into-relaunched-trainer idea.  Safe because
+# the closures are pure functions of their arguments: modules are
+# stateless config-built definitions (params always passed in), and the
+# signature pins the exact config that built them.
+_STAGE_PROGRAMS: Dict[str, Any] = {}
 
 
 @dataclass
@@ -104,6 +121,10 @@ class ServingStats:
     finished: int = 0
     preemptions: int = 0
     queue_stalls: int = 0
+    # bounded-admission accounting: submissions refused (policy
+    # "reject") or displaced (policy "shed") by a full queue — load
+    # shedding is only acceptable when it is visible
+    queue_rejections: int = 0
     compiles: int = 0
     # gauges
     queue_depth: int = 0
@@ -135,6 +156,7 @@ class ServingStats:
             finished=self.finished,
             preemptions=self.preemptions,
             queue_stalls=self.queue_stalls,
+            queue_rejections=self.queue_rejections,
             compiles=self.compiles,
             queue_depth=self.queue_depth,
             batch_occupancy=self.batch_occupancy,
@@ -159,6 +181,7 @@ class _ServingStage:
         device,
         num_slots: int,
         max_len: int,
+        program_key: Optional[str] = None,
     ):
         self.stage_index = stage_index
         self.modules = list(modules)
@@ -175,6 +198,15 @@ class _ServingStage:
         ]
         self.specs = specs
         self.pool = SlotKVCachePool(specs, num_slots, device=device)
+        cached = (
+            _STAGE_PROGRAMS.get(program_key)
+            if program_key is not None else None
+        )
+        if cached is not None:
+            # same config signature -> the closures (and jax's traced/
+            # compiled cache behind their identity) are reusable as-is
+            self._decode_donated, self._prefill_donated = cached
+            return
         mods, stage_specs = self.modules, specs
 
         def decode(params_list, data, caches, index):
@@ -222,6 +254,10 @@ class _ServingStage:
         else:
             self._decode_donated = jax.jit(decode)
             self._prefill_donated = jax.jit(prefill)
+        if program_key is not None:
+            _STAGE_PROGRAMS[program_key] = (
+                self._decode_donated, self._prefill_donated
+            )
 
     def build_pool(self, num_slots: int) -> SlotKVCachePool:
         """A fresh (unassigned) slab pool for a new slot count.
@@ -256,6 +292,8 @@ class ServingEngine:
         max_len: int = 128,
         buckets: Sequence[int] = (16, 32, 64),
         prefill_batch: int = 1,
+        max_queue: Optional[int] = None,
+        queue_policy: str = "reject",
         pad_id: int = 0,
         worker_manager=None,
         partition: Optional[Sequence[int]] = None,
@@ -285,8 +323,16 @@ class ServingEngine:
         self.num_slots = int(num_slots)
         self.max_len = int(max_len)
         self.pad_id = int(pad_id)
+        if queue_policy not in ("reject", "shed"):
+            raise ValueError(
+                f"queue_policy must be 'reject' or 'shed', "
+                f"got {queue_policy!r}"
+            )
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self.queue_policy = queue_policy
         self._queue = AdmissionQueue(
-            self.bucketer, prefill_batch=prefill_batch
+            self.bucketer, prefill_batch=prefill_batch,
+            max_queue=self.max_queue,
         )
         self.prefill_batch = int(prefill_batch)
         # static_batching is the NAIVE baseline policy, kept on the same
@@ -342,6 +388,15 @@ class ServingEngine:
         self.stages: List[_ServingStage] = []
         cursor = 0
         for k, (n, dev) in enumerate(zip(counts, stage_devices)):
+            # everything the traced programs depend on: the exact layer
+            # configs of this stage's slice, the cache depth, and the
+            # donation mode (the input SHAPES — bucket, slot count —
+            # are jit cache keys already, not closure identity)
+            program_key = json.dumps(
+                [self._model_cfg[cursor:cursor + n], self.max_len,
+                 bool(_donation_enabled())],
+                sort_keys=True, default=str,
+            )
             self.stages.append(
                 _ServingStage(
                     k,
@@ -350,6 +405,7 @@ class ServingEngine:
                     dev,
                     self.num_slots,
                     self.max_len,
+                    program_key=program_key,
                 )
             )
             cursor += n
@@ -406,15 +462,72 @@ class ServingEngine:
             st.pool.release(slot)
 
     # --- request lifecycle --------------------------------------------------
-    def submit(self, request: Request) -> Request:
-        """Queue a request (admitted into a slot on a later ``step``)."""
+    def submit(self, request: Request, *, force: bool = False) -> Request:
+        """Queue a request (admitted into a slot on a later ``step``).
+
+        With ``max_queue`` set, a full queue applies ``queue_policy``:
+        ``"reject"`` refuses the newcomer (:class:`QueueFullError`
+        propagates), ``"shed"`` displaces the oldest token-less queued
+        request(s) — under overload the head has waited longest and is
+        the most likely to have already blown its deadline — marking
+        them ``REJECTED``.  Requests with committed tokens or a
+        preemption history are never shed (their stream, or the
+        admission promise already made for them, would be lost); when
+        nothing is sheddable, ``"shed"`` degrades to reject.  Either
+        way ``stats.queue_rejections`` counts every turned-away
+        request: shedding is only acceptable when visible.
+
+        ``force=True`` bypasses the bound and the policy — for
+        re-queues of ALREADY-ADMITTED requests only (the fleet's
+        migration path; preempt/reconfigure force internally): an
+        admission promise, once made, survives a replica failure.
+        """
         length = int(request.effective_prompt.size)
         if length + request.remaining > self.max_len:
             raise ValueError(
                 f"prompt ({length}) + new tokens ({request.remaining}) "
                 f"exceed max_len={self.max_len}"
             )
-        self._queue.submit(request)  # raises if no bucket fits
+        try:
+            # raises QueueFullError on a full bounded queue (unless
+            # forced) and ValueError if no bucket fits
+            self._queue.submit(request, force=force)
+        except QueueFullError:
+            tracer = get_tracer()
+            if self.queue_policy == "shed":
+                # shed until the newcomer fits: force re-queues
+                # (preemption/reconfigure/migration) may have pushed the
+                # queue past the bound, so one victim is not always
+                # enough; requests with committed tokens are never
+                # victims (shed_oldest), and when nothing is sheddable
+                # the policy degrades to reject — losing generated
+                # tokens is worse than turning a newcomer away
+                while self._queue.depth >= (self.max_queue or 0):
+                    shed = self._queue.shed_oldest()
+                    if shed is None:
+                        break
+                    shed.status = REJECTED
+                    self.stats.queue_rejections += 1
+                    if tracer is not None:
+                        tracer.instant(
+                            "queue_shed",
+                            tracer.lane("serving", "engine"),
+                            {"shed": shed.request_id,
+                             "admitted": request.request_id},
+                        )
+                if self._queue.depth < (self.max_queue or 0):
+                    self._queue.submit(request)
+                    self.stats.admitted += 1
+                    self.stats.queue_depth = self._queue.depth
+                    return request
+            self.stats.queue_rejections += 1
+            if tracer is not None:
+                tracer.instant(
+                    "queue_reject", tracer.lane("serving", "engine"),
+                    {"request": request.request_id,
+                     "depth": self._queue.depth},
+                )
+            raise
         self.stats.admitted += 1
         self.stats.queue_depth = self._queue.depth
         return request
@@ -440,9 +553,42 @@ class ServingEngine:
                 "preempt", tracer.lane("serving", "engine"),
                 {"request": request_id},
             )
-        self._queue.submit(request)
+        # force: the queue bound gates NEW admissions only — a preempted
+        # request is already admitted and dropping it loses its tokens
+        self._queue.submit(request, force=True)
         self.stats.queue_depth = self._queue.depth
         return request
+
+    def drain(self) -> List[Request]:
+        """Evict everything and return it, token streams intact: every
+        running request is preempted (recomputation-style) and the queue
+        emptied, FIFO order.  The fleet's migration primitive — the
+        returned requests re-submit on another engine and resume by
+        recomputing their KV prefix, so streams continue exactly.
+
+        A running request whose resume prefix has outgrown the largest
+        bucket cannot resume by recomputation; it STAYS RUNNING here
+        (``preempt``'s validate-before-evict contract) and is not
+        returned — the caller decides whether to keep stepping this
+        engine until it finishes or declare it failed."""
+        for request_id in list(self._running):
+            try:
+                self.preempt(request_id)
+            except ValueError:
+                continue  # documented: not resumable, stays running
+        drained = self._queue.drain()
+        self.stats.queue_depth = 0
+        return drained
+
+    @property
+    def running_requests(self) -> List[Request]:
+        """Requests currently holding a slot (read-only view)."""
+        return list(self._running.values())
+
+    @property
+    def queued_requests(self) -> List[Request]:
+        """Requests waiting for admission, FIFO order (read-only view)."""
+        return list(self._queue.requests)
 
     def _finish(self, request: Request, now: float) -> None:
         self._release_slot(request.slot)
@@ -617,11 +763,14 @@ class ServingEngine:
                 st.pool = pool
         self.bucketer = new_bucketer
         self.prefill_batch = new_batch
-        self._queue = AdmissionQueue(new_bucketer, prefill_batch=new_batch)
+        self._queue = AdmissionQueue(new_bucketer, prefill_batch=new_batch,
+                                     max_queue=self.max_queue)
         # evicted requests were admitted before anything still queued:
-        # they re-enter at the head so reconfiguration cannot starve them
+        # they re-enter at the head so reconfiguration cannot starve
+        # them; force — every one of these was already admitted, and a
+        # reconfigure must never shed what it only meant to re-bucket
         for r in evicted + queued:
-            self._queue.submit(r)
+            self._queue.submit(r, force=True)
         self.stats.queue_depth = self._queue.depth
         if tracer is not None:
             tracer.instant(

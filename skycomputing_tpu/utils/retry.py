@@ -57,6 +57,8 @@ def retry_call(
     retry_on: Tuple[Type[BaseException], ...] = (OSError,),
     seed: Optional[int] = None,
     sleep: Callable[[float], None] = time.sleep,
+    deadline_s: Optional[float] = None,
+    clock: Callable[[], float] = time.monotonic,
     logger=None,
     describe: Optional[str] = None,
 ) -> T:
@@ -68,13 +70,25 @@ def retry_call(
     callers' except clauses keep working.  ``seed=None`` (default) seeds
     the jitter from the process id so concurrent processes decorrelate;
     pass an explicit seed for a reproducible schedule.
+
+    ``deadline_s`` is a TOTAL budget for the call, measured on ``clock``
+    from entry: each backoff sleep is clamped to the remaining budget and
+    a failure past the deadline re-raises immediately even with attempts
+    left.  Without it, per-attempt backoff can exceed any caller
+    deadline (4 attempts at ``max_delay_s=2.0`` is up to ~7.5 s of
+    sleeping — longer than a fleet dispatch or a rendezvous formation
+    window is willing to wait).  The in-flight ``fn()`` itself is never
+    interrupted; the budget bounds only the retry loop's sleeps.
     """
     if attempts < 1:
         raise ValueError(f"attempts must be >= 1, got {attempts}")
+    if deadline_s is not None and deadline_s < 0:
+        raise ValueError(f"deadline_s must be >= 0, got {deadline_s}")
     delays = backoff_delays(
         attempts, base_delay_s, max_delay_s, jitter,
         seed if seed is not None else os.getpid(),
     )
+    budget_end = None if deadline_s is None else clock() + deadline_s
     last_exc: Optional[BaseException] = None
     for attempt in range(attempts):
         try:
@@ -84,6 +98,17 @@ def retry_call(
             if attempt == attempts - 1:
                 raise
             delay = delays[attempt]
+            if budget_end is not None:
+                remaining = budget_end - clock()
+                if remaining <= 0.0:
+                    if logger is not None:
+                        what = describe or getattr(fn, "__name__", "call")
+                        logger.info(
+                            f"retry deadline ({deadline_s:.3f}s) exhausted "
+                            f"after {attempt + 1} attempt(s) of {what}"
+                        )
+                    raise
+                delay = min(delay, remaining)
             if logger is not None:
                 what = describe or getattr(fn, "__name__", "call")
                 logger.info(

@@ -1,0 +1,627 @@
+"""Serving-fleet contracts (CPU-deterministic, tier-1).
+
+The fleet's correctness story extends the engine's token-identity
+invariant across failures: whatever the supervisor does — replica
+crash, sick-replica drain, slot-leak re-form, migration onto survivors
+— every request that the fleet accepted and finished must equal the
+one-shot full-forward ``generate`` for its prompt, with zero lost and
+zero duplicated tokens.  The robustness story is explicit degradation:
+every request turned away is counted with a reason and a Retry-After
+hint, never silently dropped.  Chaos is scripted through the seeded
+``FaultPlan`` fleet vocabulary so each scenario replays exactly.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from skycomputing_tpu.builder import build_layer_stack
+from skycomputing_tpu.dynamics import (
+    FaultInjectionHook,
+    FaultPlan,
+    FleetFaultInjector,
+    WorkerManager,
+)
+from skycomputing_tpu.fleet import (
+    AdmissionController,
+    FleetSupervisor,
+    Router,
+    ServingFleet,
+)
+from skycomputing_tpu.fleet.admission import (
+    DEADLINE_UNMEETABLE,
+    NO_HEALTHY_REPLICA,
+    QUEUE_FULL,
+    SHED_LOW_PRIORITY,
+)
+from skycomputing_tpu.fleet.replica import DRAINING, HEALTHY, RETIRED
+from skycomputing_tpu.models.gpt import (
+    GptConfig,
+    generate,
+    gpt_layer_configs,
+)
+from skycomputing_tpu.serving import (
+    QueueFullError,
+    Request,
+    ServingEngine,
+)
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    """Tiny GPT + host params + jitted one-shot forward reference
+    (the test_serving fixture, shared by every fleet scenario)."""
+    cfg = GptConfig(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=2, max_position_embeddings=64,
+                    dropout_prob=0.0, dtype="float32")
+    layer_cfgs = gpt_layer_configs(cfg, deterministic=True)
+    stack = build_layer_stack(layer_cfgs)
+    params = stack.init(jax.random.key(7), np.ones((1, 5), np.int32))
+    fwd = jax.jit(lambda ids: stack.apply(params, ids))
+    return layer_cfgs, params, fwd
+
+
+def reference(fwd, request):
+    out = generate(fwd, request.prompt[None],
+                   max_new_tokens=request.max_new_tokens,
+                   context_length=64)
+    return out[0]
+
+
+def mixed_requests(rng, specs):
+    return [
+        Request(prompt=rng.integers(1, 512, (l,)).astype(np.int32),
+                max_new_tokens=n)
+        for l, n in specs
+    ]
+
+
+def fast_supervisor(**kw):
+    """Supervisor tuned for seconds-scale tests: detect every tick, one
+    missed beat is death."""
+    defaults = dict(check_every=1, heartbeat_misses=1, grace_ticks=2,
+                    baseline_ticks=3, k_checks=2, sick_threshold=3.0)
+    defaults.update(kw)
+    return FleetSupervisor(**defaults)
+
+
+def assert_identity(fwd, requests, outputs):
+    """Zero lost, zero duplicated tokens: byte-exact vs one-shot."""
+    for r in requests:
+        np.testing.assert_array_equal(
+            outputs[r.request_id], reference(fwd, r)
+        )
+
+
+# --------------------------------------------------------------------------
+# router decision logic (pure, synthetic snapshots)
+# --------------------------------------------------------------------------
+
+
+def snap(name, healthy=True, slots=4, free=4, depth=0, tpot=None):
+    return dict(name=name, healthy=healthy, slots=slots,
+                free_slots=free, queue_depth=depth, tpot_p95_s=tpot)
+
+
+def test_router_least_loaded_under_skew():
+    router = Router()
+    snaps = [
+        snap("a", depth=5, free=0),   # deeply backed up
+        snap("b", depth=0, free=2),   # 2 occupied
+        snap("c", depth=0, free=4),   # idle
+    ]
+    assert router.choose(snaps) == "c"
+    # outstanding work counts occupied slots, not just queue depth
+    assert router.rank(snaps) == ["c", "b", "a"]
+    # a slow replica (high TPOT) is more loaded at equal depth
+    snaps = [snap("a", free=0, tpot=0.5), snap("b", free=0, tpot=0.01)]
+    assert router.choose(snaps) == "b"
+    # only healthy replicas participate; none healthy -> no target
+    snaps = [snap("a", healthy=False), snap("b")]
+    assert router.rank(snaps) == ["b"]
+    assert router.choose([snap("a", healthy=False)]) is None
+
+
+def test_router_prefix_affinity_with_slack():
+    router = Router(affinity_slack=2.0)
+    prompt = list(range(1, 12))
+    snaps = [snap("a"), snap("b")]
+    assert router.choose(snaps, prompt) == "a"  # name tie-break
+    router.record_dispatch("b", prompt)
+    # sticky while b's load is within slack of the best...
+    snaps = [snap("a"), snap("b", free=2)]  # b load 2, a load 0
+    assert router.choose(snaps, prompt) == "b"
+    # ...but never onto an overloaded replica
+    snaps = [snap("a"), snap("b", free=0, depth=3)]
+    assert router.choose(snaps, prompt) == "a"
+    # a different prefix has no affinity
+    assert router.choose([snap("a"), snap("b", free=2)],
+                         list(range(50, 60))) == "a"
+    # death forgets the affinity
+    assert router.forget_replica("b") == 1
+    assert router.choose([snap("a"), snap("b", free=2)], prompt) == "a"
+
+
+# --------------------------------------------------------------------------
+# admission decision logic (pure, synthetic state)
+# --------------------------------------------------------------------------
+
+
+def test_admission_bounds_priorities_and_deadlines():
+    adm = AdmissionController(max_pending=8, shed_fraction=0.5,
+                              service_s_estimate=0.1)
+    ok = adm.decide(pending=0, capacity_slots=4)
+    assert ok.admitted
+    # full queue rejects with a positive, pending-monotone hint
+    full = adm.decide(pending=8, capacity_slots=4)
+    fuller = adm.decide(pending=16, capacity_slots=4)
+    assert not full.admitted and full.reason == QUEUE_FULL
+    assert full.retry_after_s > 0
+    assert fuller.retry_after_s > full.retry_after_s
+    # the shed band: batch sheds, interactive still admits
+    shed = adm.decide(pending=5, capacity_slots=4, priority="batch")
+    keep = adm.decide(pending=5, capacity_slots=4,
+                      priority="interactive")
+    assert not shed.admitted and shed.reason == SHED_LOW_PRIORITY
+    assert shed.retry_after_s > 0
+    assert keep.admitted
+    # deadline-aware: an unmeetable deadline is rejected up front
+    # (pending 3 sits below the shed band, so the deadline gate decides)
+    late = adm.decide(pending=3, capacity_slots=1, deadline_s=0.05)
+    assert not late.admitted and late.reason == DEADLINE_UNMEETABLE
+    assert adm.decide(pending=3, capacity_slots=1,
+                      deadline_s=10.0).admitted
+    # dead fleet: nothing admits
+    dead = adm.decide(pending=0, capacity_slots=0)
+    assert not dead.admitted and dead.reason == NO_HEALTHY_REPLICA
+    with pytest.raises(ValueError, match="priority"):
+        adm.decide(pending=0, capacity_slots=4, priority="vip")
+    # default bound scales with live capacity (tightens as replicas die)
+    auto = AdmissionController(queue_factor=2.0)
+    assert auto.pending_bound(8) == 16 and auto.pending_bound(4) == 8
+
+
+# --------------------------------------------------------------------------
+# bounded single-engine admission queue (the satellite)
+# --------------------------------------------------------------------------
+
+
+def test_engine_bounded_queue_reject_policy(gpt):
+    layer_cfgs, params, _ = gpt
+    engine = ServingEngine(layer_cfgs, params, num_slots=1, max_len=64,
+                           buckets=(8,), max_queue=2)
+    rng = np.random.default_rng(0)
+    a, b, c = mixed_requests(rng, [(4, 3)] * 3)
+    engine.submit(a)
+    engine.submit(b)
+    with pytest.raises(QueueFullError) as exc_info:
+        engine.submit(c)
+    assert exc_info.value.queue_depth == 2
+    assert engine.stats.queue_rejections == 1
+    assert engine.stats.snapshot()["queue_rejections"] == 1
+    # the rejected request's state was never mutated
+    assert c.status == "queued" and c.submitted_s is None
+
+
+def test_engine_bounded_queue_shed_policy(gpt):
+    layer_cfgs, params, _ = gpt
+    engine = ServingEngine(layer_cfgs, params, num_slots=1, max_len=64,
+                           buckets=(8,), max_queue=2,
+                           queue_policy="shed")
+    rng = np.random.default_rng(1)
+    a, b, c = mixed_requests(rng, [(4, 3)] * 3)
+    engine.submit(a)
+    engine.submit(b)
+    engine.submit(c)  # sheds the oldest (a), admits c
+    assert a.status == "rejected"
+    assert engine.stats.queue_rejections == 1
+    assert [r.request_id for r in engine.queued_requests] == [
+        b.request_id, c.request_id
+    ]
+    with pytest.raises(ValueError, match="queue_policy"):
+        ServingEngine(layer_cfgs, params, num_slots=1, max_len=64,
+                      buckets=(8,), queue_policy="drop")
+
+
+def test_shed_never_drops_committed_tokens(gpt):
+    """Shed victims are token-less only: a preempted (force-requeued)
+    request with committed tokens is never shed — when nothing is
+    sheddable, the policy degrades to reject, and an over-bound queue
+    (force re-queues) sheds as many token-less victims as needed
+    without raising."""
+    layer_cfgs, params, fwd = gpt
+    engine = ServingEngine(layer_cfgs, params, num_slots=1, max_len=64,
+                           buckets=(8, 16), max_queue=1,
+                           queue_policy="shed")
+    rng = np.random.default_rng(10)
+    resume_a, fresh, newcomer, last = mixed_requests(
+        rng, [(5, 8), (4, 3), (3, 3), (3, 2)]
+    )
+    # a request mid-decode, preempted -> fills the queue with a
+    # committed-token resume (force past the bound)
+    engine.submit(resume_a)
+    engine.step()
+    engine.preempt(resume_a.request_id)
+    assert engine.stats.queue_depth == 1
+    # nothing sheddable (the resume has tokens): shed degrades to
+    # reject instead of discarding the stream or raising mid-shed
+    with pytest.raises(QueueFullError):
+        engine.submit(newcomer)
+    assert engine.stats.queue_rejections == 1
+    assert resume_a.tokens  # stream intact
+    # drain the resumes, then overfill with token-less requests via
+    # preempt interleaving: shed clears as many as needed, no raise
+    engine.run()
+    np.testing.assert_array_equal(resume_a.output(),
+                                  reference(fwd, resume_a))
+    engine.submit(fresh)
+    engine.submit(last)  # sheds `fresh` (token-less), admits
+    assert fresh.status == "rejected"
+    assert engine.stats.queue_rejections == 2
+
+
+def test_preemption_bypasses_queue_bound(gpt):
+    """The bound gates NEW admissions only: a preempted (already
+    admitted) request always re-queues — shedding it would lose its
+    committed tokens."""
+    layer_cfgs, params, fwd = gpt
+    engine = ServingEngine(layer_cfgs, params, num_slots=1, max_len=64,
+                           buckets=(8, 16), max_queue=1)
+    rng = np.random.default_rng(2)
+    victim, waiter = mixed_requests(rng, [(5, 8), (4, 4)])
+    engine.submit(victim)
+    engine.step()  # victim takes the slot
+    engine.submit(waiter)  # fills the bounded queue
+    engine.preempt(victim.request_id)  # queue full -> force path
+    assert engine.stats.queue_depth == 2
+    assert engine.stats.queue_rejections == 0
+    engine.run()
+    np.testing.assert_array_equal(victim.output(),
+                                  reference(fwd, victim))
+    np.testing.assert_array_equal(waiter.output(),
+                                  reference(fwd, waiter))
+
+
+def test_engine_drain_migrates_streams_intact(gpt):
+    """``drain()`` is the migration primitive: mid-decode eviction off
+    one engine, resume on a DIFFERENT engine, streams byte-identical."""
+    layer_cfgs, params, fwd = gpt
+    devices = jax.devices()
+    src = ServingEngine(layer_cfgs, params, num_slots=2, max_len=64,
+                        buckets=(8, 16), devices=[devices[0]])
+    dst = ServingEngine(layer_cfgs, params, num_slots=2, max_len=64,
+                        buckets=(8, 16), devices=[devices[1]])
+    rng = np.random.default_rng(3)
+    requests = mixed_requests(rng, [(5, 9), (3, 6), (7, 8)])
+    for r in requests:
+        src.submit(r)
+    for _ in range(3):
+        src.step()  # all mid-flight on src
+    moved = src.drain()
+    assert len(moved) == 3 and not src.has_work()
+    assert all(r.slot is None for r in moved)
+    for r in moved:
+        dst.submit(r)
+    dst.run()
+    for r in requests:
+        np.testing.assert_array_equal(r.output(), reference(fwd, r))
+
+
+# --------------------------------------------------------------------------
+# fleet end-to-end
+# --------------------------------------------------------------------------
+
+
+def test_fleet_routes_and_serves_token_identical(gpt, devices):
+    layer_cfgs, params, fwd = gpt
+    fleet = ServingFleet(
+        layer_cfgs, params, replicas=2,
+        engine_kwargs=dict(num_slots=2, max_len=64, buckets=(8, 16)),
+        supervisor=fast_supervisor(),
+        devices=devices,
+    )
+    rng = np.random.default_rng(4)
+    requests = mixed_requests(
+        rng, [(5, 9), (3, 4), (12, 7), (7, 5), (16, 6), (2, 8)]
+    )
+    decisions = [fleet.submit(r) for r in requests]
+    assert all(d.admitted and d.replica for d in decisions)
+    # least-loaded routing spread the work over both replicas
+    assert len({d.replica for d in decisions}) == 2
+    outputs = fleet.run()
+    assert_identity(fwd, requests, outputs)
+    snap = fleet.metrics.snapshot()
+    assert snap["fleet"]["dispatched"] == 6
+    assert snap["fleet"]["failed"] == 0
+    assert snap["fleet"]["ttft_p95_s"] > 0
+    assert "replica0" in snap and "replica1" in snap
+
+
+def test_fleet_replica_kill_zero_lost_tokens(gpt, devices):
+    """The headline chaos contract: kill a replica mid-run; its
+    in-flight requests migrate recomputation-style onto survivors and
+    every accepted request finishes token-identical — zero lost, zero
+    duplicated tokens — while the dead replica re-forms."""
+    from skycomputing_tpu import telemetry
+
+    layer_cfgs, params, fwd = gpt
+    plan = FaultPlan(
+        [dict(iter=6, kind="replica_crash", replica=0)], seed=0
+    )
+    fleet = ServingFleet(
+        layer_cfgs, params, replicas=3,
+        engine_kwargs=dict(num_slots=2, max_len=64, buckets=(8, 16)),
+        supervisor=fast_supervisor(),
+        fault_injector=FleetFaultInjector(plan),
+        devices=devices,
+    )
+    rng = np.random.default_rng(5)
+    requests = mixed_requests(
+        rng,
+        [(5, 9), (3, 6), (12, 7), (7, 5), (16, 6), (2, 11), (6, 8),
+         (9, 4)],
+    )
+    telemetry.enable_tracing()
+    try:
+        outputs = fleet.run(requests)
+    finally:
+        tracer = telemetry.get_tracer()
+        events = tracer.to_chrome()["traceEvents"] if tracer else []
+        telemetry.disable_tracing()
+    assert len(outputs) == len(requests)
+    assert_identity(fwd, requests, outputs)
+    assert fleet.stats.failed == 0
+    assert fleet.stats.migrations > 0
+    assert fleet.stats.reforms == 1
+    assert fleet.replicas[0].generation == 1
+    assert fleet.replicas[0].state == HEALTHY
+    kinds = [e["kind"] for e in fleet.supervisor.events]
+    assert kinds[:3] == ["detect", "drain", "migrate"]
+    assert "reformed" in kinds
+    # the whole arc is visible on the fleet trace lane
+    arcs = [e for e in events if e.get("name") == "fleet_heal"]
+    assert {e["ph"] for e in arcs} == {"b", "e"}
+    ends = [e for e in arcs if e["ph"] == "e"]
+    assert ends[-1]["args"]["outcome"] == "reformed"
+    spans = {e["name"] for e in events if e["ph"] == "X"}
+    assert {"fleet.drain", "fleet.migrate", "fleet.reform"} <= spans
+
+
+def test_fleet_sick_replica_drains_to_survivors(gpt, devices):
+    """A latency-spiked replica is detected by the EWMA health score,
+    drained through the preempt contract, and re-formed; requests that
+    cannot re-bucket finish on the DRAINING replica — nothing fails."""
+    layer_cfgs, params, fwd = gpt
+    plan = FaultPlan(
+        [dict(iter=8, kind="latency_spike", replica=1, seconds=0.05)],
+        seed=0,
+    )
+    fleet = ServingFleet(
+        layer_cfgs, params, replicas=2,
+        engine_kwargs=dict(num_slots=2, max_len=64, buckets=(8, 16)),
+        supervisor=fast_supervisor(),
+        fault_injector=FleetFaultInjector(plan),
+        devices=devices,
+    )
+    rng = np.random.default_rng(6)
+    requests = mixed_requests(
+        rng, [(5, 20), (3, 18), (12, 16), (7, 15), (6, 14), (9, 12)]
+    )
+    outputs = fleet.run(requests)
+    assert len(outputs) == len(requests)
+    assert_identity(fwd, requests, outputs)
+    assert fleet.stats.failed == 0
+    detects = [e for e in fleet.supervisor.events
+               if e["kind"] == "detect"]
+    assert detects and detects[0]["reason"] == "latency"
+    assert detects[0]["score"] >= 3.0
+    assert fleet.stats.reforms >= 1
+    assert all(r.state == HEALTHY for r in fleet.replicas)
+
+
+def test_fleet_slot_leak_detected_and_reformed(gpt, devices):
+    layer_cfgs, params, fwd = gpt
+    plan = FaultPlan(
+        [dict(iter=4, kind="slot_leak", replica=0, count=2)], seed=0
+    )
+    fleet = ServingFleet(
+        layer_cfgs, params, replicas=2,
+        engine_kwargs=dict(num_slots=2, max_len=64, buckets=(8,)),
+        supervisor=fast_supervisor(),
+        fault_injector=FleetFaultInjector(plan),
+        devices=devices,
+    )
+    rng = np.random.default_rng(7)
+    requests = mixed_requests(rng, [(4, 12), (5, 10), (3, 14), (6, 9)])
+    outputs = fleet.run(requests)
+    assert_identity(fwd, requests, outputs)
+    reasons = [e["reason"] for e in fleet.supervisor.events
+               if e["kind"] == "detect"]
+    assert "slot_leak" in reasons
+    assert fleet.stats.reforms >= 1
+    # the re-formed replica's pool is whole again
+    rep = fleet.replicas[0]
+    assert rep.generation >= 1 and rep.slot_accounting_ok
+    assert rep.engine.stages[0].pool.free_slots == 2
+
+
+def test_fleet_reform_rollback_on_infeasible_reallocation(gpt, devices):
+    """A re-form whose serving pre-flight rejects (the re-allocation no
+    longer fits its budgets) rolls back structurally: no half-built
+    replica, the fleet keeps serving on survivors, the failure is
+    counted and the replica retires when its budget exhausts."""
+    layer_cfgs, params, fwd = gpt
+    wm = WorkerManager()
+    wm.load_worker_pool_from_config([
+        dict(name="n0", device_config=dict(device_index=0),
+             extra_config=dict(mem_limit=10_000.0))
+    ])
+    worker = wm.worker_pool[0]
+    worker.model_config = layer_cfgs
+    worker.order = worker.rank + 1
+    fleet = ServingFleet(
+        layer_cfgs, params,
+        replica_specs=[
+            dict(worker_manager=wm, devices=[devices[0]]),
+            dict(devices=[devices[1]]),
+        ],
+        engine_kwargs=dict(num_slots=2, max_len=64, buckets=(8, 16)),
+        supervisor=fast_supervisor(max_reforms=1),
+        fault_injector=FleetFaultInjector(FaultPlan(
+            [dict(iter=3, kind="replica_crash", replica=0)], seed=0
+        )),
+    )
+    # the world changed AFTER replica0 was built: its budget no longer
+    # fits the slabs, so the re-form's verify-then-apply must reject
+    worker.extra_config["mem_limit"] = 0.05
+    rng = np.random.default_rng(8)
+    requests = mixed_requests(
+        rng, [(5, 9), (3, 7), (12, 8), (7, 6), (6, 9), (9, 5)]
+    )
+    outputs = fleet.run(requests)
+    assert len(outputs) == len(requests)
+    assert_identity(fwd, requests, outputs)
+    assert fleet.stats.reform_failures == 1
+    assert fleet.stats.reforms == 0
+    assert fleet.replicas[0].state == RETIRED
+    assert fleet.replicas[1].state == HEALTHY
+    failed = [e for e in fleet.supervisor.events
+              if e["kind"] == "reform_failed"]
+    assert failed and "pre-flight" in failed[0]["error"]
+
+
+def test_fleet_shed_under_overload_is_counted_never_silent(gpt, devices):
+    """A 2x admission spike against a bounded fleet: the overflow is
+    rejected with reasons and Retry-After hints, interactive traffic
+    outlives batch traffic, and every ACCEPTED request still finishes
+    token-identical."""
+    layer_cfgs, params, fwd = gpt
+    fleet = ServingFleet(
+        layer_cfgs, params, replicas=2,
+        engine_kwargs=dict(num_slots=2, max_len=64, buckets=(8,)),
+        admission=AdmissionController(max_pending=4, shed_fraction=0.5),
+        supervisor=fast_supervisor(),
+        devices=devices,
+    )
+    rng = np.random.default_rng(9)
+    batch = mixed_requests(rng, [(4, 6)] * 8)
+    interactive = mixed_requests(rng, [(5, 5)] * 2)
+    decisions = [fleet.submit(r) for r in batch]
+    keep = [fleet.submit(r, priority="interactive")
+            for r in interactive]
+    rejected = [d for d in decisions + keep if not d.admitted]
+    accepted = [r for r, d in
+                zip(batch + interactive, decisions + keep)
+                if d.admitted]
+    assert rejected, "the spike must shed"
+    assert all(d.reason and d.retry_after_s > 0 for d in rejected)
+    # interactive is admitted past the shed band (pending < hard bound)
+    assert sum(d.admitted for d in keep) > 0
+    assert fleet.stats.rejected == len(rejected)
+    assert sum(fleet.stats.rejected_by_reason.values()) == len(rejected)
+    outputs = fleet.run()
+    assert len(outputs) == len(accepted)
+    assert_identity(fwd, accepted, outputs)
+    # shed requests are terminally marked, not limbo'd
+    for r, d in zip(batch + interactive, decisions + keep):
+        if not d.admitted:
+            assert r.status == "rejected"
+
+
+# --------------------------------------------------------------------------
+# fault vocabulary (seeded-determinism contract)
+# --------------------------------------------------------------------------
+
+
+def test_fleet_fault_vocabulary_validation():
+    # required fields enforced at plan construction
+    with pytest.raises(ValueError, match="missing required field"):
+        FaultPlan([dict(iter=0, kind="replica_crash")])
+    with pytest.raises(ValueError, match="missing required field"):
+        FaultPlan([dict(iter=0, kind="slot_leak")])
+    # each applier rejects the other's vocabulary at construction
+    fleet_plan = FaultPlan(
+        [dict(iter=0, kind="replica_crash", replica=0)]
+    )
+    trainer_plan = FaultPlan(
+        [dict(iter=0, kind="slowdown", worker=0, factor=2.0)]
+    )
+    with pytest.raises(ValueError, match="FleetFaultInjector"):
+        FaultInjectionHook(fleet_plan)
+    with pytest.raises(ValueError, match="FaultInjectionHook"):
+        FleetFaultInjector(trainer_plan)
+    FleetFaultInjector(fleet_plan)  # its own vocabulary is fine
+    # replica indices are range-checked on the first tick, before
+    # anything fires — not 50 ticks into a chaos run
+    injector = FleetFaultInjector(FaultPlan(
+        [dict(iter=40, kind="replica_crash", replica=7)]
+    ))
+
+    class _Fleet:
+        tick = 0
+        replicas = [object(), object()]
+
+    with pytest.raises(ValueError, match="replica indices \\[7\\]"):
+        injector.on_tick(_Fleet())
+
+
+def test_successful_reforms_refund_the_budget(gpt, devices):
+    """max_reforms bounds CONSECUTIVE failures: a fleet that keeps
+    proving it can heal a replica must not retire it after N lifetime
+    faults."""
+    layer_cfgs, params, fwd = gpt
+    plan = FaultPlan(
+        [dict(iter=4, kind="replica_crash", replica=0),
+         dict(iter=14, kind="replica_crash", replica=0),
+         dict(iter=24, kind="replica_crash", replica=0)],
+        seed=0,
+    )
+    fleet = ServingFleet(
+        layer_cfgs, params, replicas=2,
+        engine_kwargs=dict(num_slots=2, max_len=64, buckets=(8, 16)),
+        supervisor=fast_supervisor(max_reforms=2),
+        fault_injector=FleetFaultInjector(plan),
+        devices=devices,
+    )
+    rng = np.random.default_rng(11)
+    requests = mixed_requests(
+        rng, [(5, 16), (3, 14), (7, 15), (6, 12), (9, 13), (4, 11)]
+    )
+    outputs = fleet.run(requests)
+    assert_identity(fwd, requests, outputs)
+    # three successful heals of the same replica under max_reforms=2
+    assert fleet.stats.reforms == 3
+    assert fleet.replicas[0].state == HEALTHY
+    assert fleet.replicas[0].generation == 3
+
+
+def test_latency_spike_unpinned_seconds_is_seeded():
+    """An event that leaves ``seconds`` open draws from the plan's
+    generator: same seed, same spike — the determinism contract."""
+    draws = []
+    for _ in range(2):
+        plan = FaultPlan(
+            [dict(iter=0, kind="latency_spike", replica=0)], seed=11
+        )
+        injector = FleetFaultInjector(plan)
+
+        class _Replica:
+            name = "r0"
+
+            def inject_stall(self, seconds, clear_at_tick=None):
+                draws.append(seconds)
+
+        class _Fleet:
+            tick = 0
+            replicas = [_Replica()]
+
+            def replica_by_index(self, i):
+                return self.replicas[i]
+
+        injector.on_tick(_Fleet())
+        assert injector.applied[0]["seconds"] == draws[-1]
+    assert draws[0] == draws[1] > 0
+    assert FaultPlan([], seed=11).draw_spike_seconds() == draws[0]

@@ -164,6 +164,55 @@ def test_retry_call_does_not_retry_unlisted_exceptions():
     assert len(calls) == 1
 
 
+def test_retry_call_deadline_clamps_and_expires():
+    """The total-deadline budget: sleeps clamp to the remaining budget
+    and a failure past the deadline re-raises the ORIGINAL exception
+    immediately, attempts left or not (a rendezvous read or a fleet
+    dispatch must give up within the caller's patience)."""
+    fake_now = [0.0]
+    sleeps = []
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        fake_now[0] += s
+
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise OSError("gone")
+
+    with pytest.raises(OSError, match="gone"):
+        retry_call(always, attempts=10, base_delay_s=1.0, max_delay_s=8.0,
+                   jitter=0.0, seed=0, sleep=fake_sleep,
+                   deadline_s=4.5, clock=lambda: fake_now[0])
+    # schedule without a deadline would be 1, 2, 4, 8, ... — the budget
+    # admits 1 + 2 then clamps the third sleep to the remaining 1.5s,
+    # and the next failure (past the deadline) re-raises: 4 calls total
+    assert sleeps == [1.0, 2.0, 1.5]
+    assert len(calls) == 4
+    # un-deadlined behavior is untouched
+    assert backoff_delays(4, 1.0, 8.0, 0.0, seed=0) == [1.0, 2.0, 4.0]
+
+
+def test_retry_call_deadline_zero_means_single_round():
+    """deadline_s=0: the first attempt runs, the first retryable
+    failure propagates — no sleeps at all."""
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise OSError("gone")
+
+    sleeps = []
+    with pytest.raises(OSError):
+        retry_call(always, attempts=5, sleep=sleeps.append,
+                   deadline_s=0.0)
+    assert len(calls) == 1 and sleeps == []
+    with pytest.raises(ValueError, match="deadline_s"):
+        retry_call(lambda: 1, deadline_s=-1.0)
+
+
 # --------------------------------------------------------------------------
 # FaultPlan
 # --------------------------------------------------------------------------
