@@ -41,7 +41,11 @@ from .serving import Request, ServingEngine
 from .tuning import ServingAutotuner, TuningAdvisor
 from .stimulator import Stimulator
 from .telemetry import (
+    MetricsExporter,
     MetricsRegistry,
+    MetricsTimeseries,
+    SloMonitor,
+    SloTarget,
     Tracer,
     disable_tracing,
     enable_tracing,
@@ -88,7 +92,11 @@ __all__ = [
     "ServingAutotuner",
     "TuningAdvisor",
     "Stimulator",
+    "MetricsExporter",
     "MetricsRegistry",
+    "MetricsTimeseries",
+    "SloMonitor",
+    "SloTarget",
     "Tracer",
     "enable_tracing",
     "disable_tracing",

@@ -72,6 +72,8 @@ class AdmissionController:
         queue_factor: float = 4.0,
         shed_fraction: float = 0.75,
         service_s_estimate: float = 0.05,
+        slo_monitor=None,
+        slo_tighten: float = 0.5,
     ):
         if max_pending is not None and max_pending < 1:
             raise ValueError(
@@ -81,21 +83,42 @@ class AdmissionController:
             raise ValueError(
                 f"shed_fraction must be in (0, 1], got {shed_fraction}"
             )
+        if not 0.0 < slo_tighten <= 1.0:
+            raise ValueError(
+                f"slo_tighten must be in (0, 1], got {slo_tighten}"
+            )
         self.max_pending = max_pending
         self.queue_factor = float(queue_factor)
         self.shed_fraction = float(shed_fraction)
         self.service_s_estimate = float(service_s_estimate)
+        # optional online-SLO signal (telemetry.slo.SloMonitor, but
+        # DUCK-TYPED — this module stays pure stdlib / file-path
+        # loadable): while any declared SLO burns, the pending bound
+        # tightens by slo_tighten, shedding load before the burn
+        # exhausts the error budget.  The decision stays pure: the
+        # monitor only moves the bound, visibly (detail carries it).
+        self.slo_monitor = slo_monitor
+        self.slo_tighten = float(slo_tighten)
 
     # --- sizing -------------------------------------------------------------
+    def _slo_burning(self) -> bool:
+        return bool(self.slo_monitor is not None
+                    and getattr(self.slo_monitor, "firing", ()))
+
     def pending_bound(self, capacity_slots: int) -> int:
         """The effective pending bound for the current live capacity.
 
         An explicit ``max_pending`` wins; otherwise ``queue_factor ×``
         the healthy fleet's slot capacity — the bound shrinks when
-        replicas die, which is exactly when admission must tighten."""
+        replicas die, which is exactly when admission must tighten.
+        A firing SLO monitor tightens either form by ``slo_tighten``."""
         if self.max_pending is not None:
-            return self.max_pending
-        return max(1, int(self.queue_factor * max(capacity_slots, 0)))
+            bound = self.max_pending
+        else:
+            bound = max(1, int(self.queue_factor * max(capacity_slots, 0)))
+        if self._slo_burning():
+            bound = max(1, int(bound * self.slo_tighten))
+        return bound
 
     def _service_s(self, tpot_p50_s: Optional[float]) -> float:
         """Per-queue-position wait estimate: observed decode pace when
@@ -148,10 +171,12 @@ class AdmissionController:
         retry_after_s = self.estimate_wait_s(
             over, capacity_slots, tpot_p50_s
         )
+        slo_tightened = self._slo_burning()
         if pending >= bound:
             return AdmitDecision(
                 False, reason=QUEUE_FULL, retry_after_s=retry_after_s,
-                detail=dict(pending=pending, bound=bound),
+                detail=dict(pending=pending, bound=bound,
+                            slo_tightened=slo_tightened),
             )
         if (priority != INTERACTIVE
                 and pending >= self.shed_fraction * bound):

@@ -41,8 +41,8 @@ import jax
 import numpy as np
 
 from ..serving.batcher import FAILED, FINISHED, QueueFullError, REJECTED, Request
-from ..serving.engine import ServingEngine
-from ..telemetry import MetricsRegistry, get_tracer
+from ..serving.engine import ServingEngine, ServingStats
+from ..telemetry import LiveMetricsMixin, MetricsRegistry, get_tracer
 from ..utils import Logger
 from ..utils.retry import retry_call
 from .admission import (
@@ -95,6 +95,24 @@ class FleetStats:
             self.rejected_by_reason.get(reason, 0) + 1
         )
 
+    #: metric classification (telemetry.MetricsRegistry contract):
+    #: counters are cumulative for the FLEET's lifetime — re-forms and
+    #: reconfigurations never reset them — so time-series rates over
+    #: the fleet source are always well-defined.  The percentile keys
+    #: the fleet snapshot adds are gauges over rolling windows.
+    FIELD_TYPES = {
+        "submitted": "counter", "admitted": "counter",
+        "dispatched": "counter", "rejected": "counter",
+        "rejected_by_reason": "counter", "migrations": "counter",
+        "failed": "counter", "reforms": "counter",
+        "reform_failures": "counter", "missed_beats": "counter",
+        "ticks": "counter",
+        "replicas_healthy": "gauge", "pending": "gauge",
+        "limbo_depth": "gauge",
+        "ttft_p50_s": "gauge", "ttft_p95_s": "gauge",
+        "tpot_p50_s": "gauge", "tpot_p95_s": "gauge",
+    }
+
     def snapshot(self) -> Dict[str, Any]:
         return dict(
             submitted=self.submitted,
@@ -114,7 +132,7 @@ class FleetStats:
         )
 
 
-class ServingFleet:
+class ServingFleet(LiveMetricsMixin):
     """N serving-engine replicas behind routing, admission, self-heal.
 
     ``model_cfg``/``params_list`` are the standard layer-config list and
@@ -142,6 +160,7 @@ class ServingFleet:
         devices: Optional[Sequence[Any]] = None,
         finished_history: int = 4096,
         slo_window: int = 2048,
+        slo=None,
         logger: Optional[Logger] = None,
     ):
         self._logger = logger or Logger()
@@ -198,14 +217,69 @@ class ServingFleet:
         # re-dispatched at the start of every step
         self._limbo: List[Request] = []
         # one registry over the whole fleet: the "fleet" source plus one
-        # serving source per replica (same poller reads everything)
+        # serving source per replica (same poller reads everything).
+        # Replica sources go through stats_snapshot so counters stay
+        # monotonic across re-forms (see EngineReplica).
         self.metrics = MetricsRegistry()
-        self.metrics.register("fleet", self._fleet_snapshot)
+        self.metrics.register("fleet", self._fleet_snapshot,
+                              types=FleetStats.FIELD_TYPES)
         for rep in self.replicas:
-            self.metrics.register(
-                rep.name,
-                (lambda r=rep: r.engine.stats.snapshot()),
-            )
+            self.metrics.register(rep.name, rep.stats_snapshot,
+                                  types=ServingStats.FIELD_TYPES)
+        # live observability (LiveMetricsMixin: enable_timeseries /
+        # start_exporter; opt-in, zero-cost until enabled) plus the
+        # fleet-only leg: an online SLO monitor evaluated every tick
+        self.timeseries = None
+        self.slo = None
+        self._exporter = None
+        if slo is not None:
+            self.attach_slo(slo)
+
+    # --- live observability (LiveMetricsMixin + the SLO leg) ----------------
+    #: fleet ticks are the finest sampling grain in the repo; keep a
+    #: longer window than the single-engine default
+    _timeseries_window = 1024
+
+    def attach_slo(self, monitor):
+        """Wire an online SLO monitor into the fleet loop.
+
+        The monitor binds the fleet's time-series (created on demand),
+        registers as the ``"slo"`` metric source, and becomes the
+        optional tightening/priority signal for the admission
+        controller and supervisor — unless they already carry their
+        own.  ``step()`` then evaluates it every tick, emitting
+        ``slo_alert`` trace instants while any target burns.
+        """
+        if self.slo is not None:
+            raise ValueError("an SLO monitor is already attached")
+        if monitor.timeseries is None:
+            monitor.timeseries = self.enable_timeseries()
+        self.slo = monitor
+        self.metrics.register("slo", monitor.snapshot,
+                              types=type(monitor).FIELD_TYPES)
+        if getattr(self.admission, "slo_monitor", None) is None:
+            self.admission.slo_monitor = monitor
+        if getattr(self.supervisor, "slo_monitor", None) is None:
+            self.supervisor.slo_monitor = monitor
+        return monitor
+
+    def _health_snapshot(self) -> Dict[str, Any]:
+        """The ``/healthz`` body: per-replica lifecycle states plus an
+        overall verdict (``ok`` all healthy / ``degraded`` some /
+        ``down`` none)."""
+        states = {r.name: r.state for r in self.replicas}
+        healthy = len(self.healthy_replicas)
+        status = ("ok" if healthy == len(self.replicas)
+                  else "degraded" if healthy else "down")
+        return dict(
+            status=status,
+            tick=self.tick,
+            healthy=healthy,
+            replicas=states,
+            pending=len(self._pending),
+            limbo=len(self._limbo),
+            slo_firing=list(self.slo.firing) if self.slo else [],
+        )
 
     # --- views --------------------------------------------------------------
     def replica_by_index(self, index: int) -> EngineReplica:
@@ -253,6 +327,17 @@ class ServingFleet:
         replica it landed on."""
         self.stats.submitted += 1
         tracer = get_tracer()
+        if tracer is not None:
+            # the request's trace starts HERE: one stable id (the
+            # request_id) threads submit -> admission -> routing ->
+            # engine spans -> any migration, on one recycled lane
+            lane = tracer.request_lane(request.request_id)
+            if lane is not None:
+                tracer.instant(
+                    "submitted", lane,
+                    {"request": request.request_id,
+                     "priority": priority},
+                )
         decision = self.admission.decide(
             pending=self._pending_depth(),
             capacity_slots=self._capacity_slots(),
@@ -303,6 +388,16 @@ class ServingFleet:
                  "reason": decision.reason,
                  "retry_after_s": decision.retry_after_s},
             )
+            lane = tracer.request_lane(request.request_id,
+                                       lease=False)
+            if lane is not None:
+                tracer.instant(
+                    "rejected", lane,
+                    {"request": request.request_id,
+                     "reason": decision.reason,
+                     "retry_after_s": decision.retry_after_s},
+                )
+            tracer.release_request_lane(request.request_id)
 
     def _dispatch(self, request: Request,
                   snaps: Sequence[Dict[str, Any]],
@@ -314,6 +409,16 @@ class ServingFleet:
         ranked = self.router.rank(snaps, prompt=request.prompt)
         if not ranked:  # admission already gates on capacity; belt+braces
             raise QueueFullError("no healthy replica", 0)
+        tracer = get_tracer()
+        if tracer is not None:
+            # the router's decision, attributable per request: the
+            # ranking it produced (truncated — the winner is what
+            # matters) before the dispatch walk consumed it
+            tracer.instant(
+                "route", tracer.lane("fleet", "router"),
+                {"request": request.request_id,
+                 "ranked": ranked[:4]},
+            )
         candidates = list(ranked)
 
         def attempt() -> str:
@@ -348,6 +453,7 @@ class ServingFleet:
         """
         if not dead:
             return replica.engine.drain()
+        tracer = get_tracer()
         migrated: List[Request] = []
         for rid, name in list(self._assignment.items()):
             if name != replica.name:
@@ -366,7 +472,37 @@ class ServingFleet:
             # started migrant from ever being a shed victim downstream
             r.preemptions += 1
             migrated.append(r)
+            if tracer is not None:
+                # the dead engine can't close its own segments (its
+                # state is unreachable by contract), so the fleet —
+                # holding the ledger AND the request's open trace mark
+                # — ends whatever was in flight and stamps the
+                # migration: no orphaned spans, and the waterfall shows
+                # exactly where replica A's story stops
+                self._trace_interrupt(r, tracer, replica.name)
         return migrated
+
+    def _trace_interrupt(self, request: Request, tracer,
+                         replica_name: str) -> None:
+        """Close a collected request's open segment at its (dead)
+        replica's name and stamp the ``migrate`` marker."""
+        lane = tracer.request_lane(request.request_id, lease=False)
+        mark_decode = request.trace_marks.pop("decode", None)
+        mark_queued = request.trace_marks.pop("queued", None)
+        if lane is not None:
+            base = {"request": request.request_id,
+                    "replica": replica_name, "interrupted": True}
+            if mark_decode is not None:
+                tracer.complete(
+                    "decode", lane, mark_decode,
+                    dict(base, tokens=len(request.tokens)),
+                )
+            elif mark_queued is not None:
+                tracer.complete("queue_wait", lane, mark_queued, base)
+            tracer.instant(
+                "migrate", lane,
+                {"request": request.request_id, "from": replica_name},
+            )
 
     def redispatch(self, requests: Sequence[Request]) -> Tuple[int, int]:
         """Place migrated requests on survivors; (placed, parked).
@@ -417,6 +553,15 @@ class ServingFleet:
         # request a second time
         self._assignment.pop(request.request_id, None)
         self._limbo.append(request)
+        tracer = get_tracer()
+        if tracer is not None:
+            lane = tracer.request_lane(request.request_id,
+                                       lease=False)
+            if lane is not None:
+                tracer.instant(
+                    "limbo", lane,
+                    {"request": request.request_id},
+                )
         return "parked"
 
     def _fail(self, request: Request, why: str) -> None:
@@ -433,6 +578,14 @@ class ServingFleet:
                 "request_failed", tracer.lane("fleet", "supervisor"),
                 {"request": request.request_id, "why": why},
             )
+            lane = tracer.request_lane(request.request_id,
+                                       lease=False)
+            if lane is not None:
+                tracer.instant(
+                    "failed", lane,
+                    {"request": request.request_id, "why": why},
+                )
+            tracer.release_request_lane(request.request_id)
 
     # --- the fleet loop -----------------------------------------------------
     def has_work(self) -> bool:
@@ -486,6 +639,12 @@ class ServingFleet:
         self.stats.replicas_healthy = len(self.healthy_replicas)
         self.stats.pending = len(self._pending)
         self.stats.limbo_depth = len(self._limbo)
+        # observability tail: sample the tick's final state, then judge
+        # it — the SLO monitor must see the sample it alerts on
+        if self.timeseries is not None:
+            self.timeseries.sample()
+        if self.slo is not None:
+            self.slo.evaluate(get_tracer())
         self.tick += 1
 
     def _sweep_terminal(self) -> None:

@@ -14,9 +14,9 @@ supervisor see one consistent surface.
 from __future__ import annotations
 
 import time
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
-from ..serving.engine import ServingEngine
+from ..serving.engine import ServingEngine, ServingStats
 
 # replica lifecycle states
 HEALTHY = "healthy"    # serving traffic
@@ -41,8 +41,18 @@ class EngineReplica:
         self.name = str(name)
         self._build = build_engine
         self.engine: ServingEngine = build_engine()
+        # request-scoped trace spans attribute their segments to the
+        # replica, not the anonymous "engine"
+        self.engine.trace_name = self.name
         self.state = HEALTHY
         self.generation = 0
+        # monotonic counter discipline across re-forms: a rebuilt
+        # engine starts a fresh ServingStats, but the REPLICA's
+        # counters must never go backwards mid-run or every
+        # time-series rate over the fleet registry turns undefined at
+        # each heal.  Prior generations' cumulative counters accumulate
+        # here; stats_snapshot() adds them back.
+        self._carried: Dict[str, float] = {}
         # fault surface (written by FleetFaultInjector)
         self.crashed = False
         self._stall_s = 0.0
@@ -100,6 +110,21 @@ class EngineReplica:
     #: hot path — recent samples are both cheaper (bounded sort) and
     #: the truer routing signal (a replica's pace NOW, not its history)
     SNAPSHOT_WINDOW = 256
+
+    def stats_snapshot(self) -> dict:
+        """``ServingStats.snapshot()`` with counters made monotonic for
+        the REPLICA's lifetime: cumulative fields carry across re-forms
+        (``_carried``), so the fleet registry's per-replica source
+        never shows a counter reset mid-run.  Gauges and percentile
+        summaries stay the live engine's own.  This is the fleet's
+        registered metric source for the replica."""
+        snap = self.engine.stats.snapshot()
+        for field, base in self._carried.items():
+            value = snap.get(field)
+            if isinstance(value, (int, float)):
+                snap[field] = value + base
+        snap["generation"] = self.generation
+        return snap
 
     def snapshot(self) -> dict:
         """The router/admission view of this replica (plain scalars,
@@ -164,6 +189,16 @@ class EngineReplica:
         only then swap it in — a failed build leaves the old state
         untouched for the supervisor's rollback accounting."""
         engine = self._build()
+        # bank the dying generation's cumulative counters BEFORE the
+        # swap (the stats object is still readable even for a crashed
+        # replica — the crash is simulated at the RPC surface), so
+        # stats_snapshot() stays monotonic across the re-form
+        old = self.engine.stats
+        for field in ServingStats.COUNTER_FIELDS:
+            self._carried[field] = (
+                self._carried.get(field, 0) + getattr(old, field)
+            )
+        engine.trace_name = self.name
         self.engine = engine
         self.state = HEALTHY
         self.generation += 1

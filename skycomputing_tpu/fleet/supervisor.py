@@ -94,6 +94,7 @@ class FleetSupervisor:
         check_every: int = 2,
         max_reforms: int = 2,
         logger: Optional[Logger] = None,
+        slo_monitor=None,
     ):
         if check_every < 1 or heartbeat_misses < 1 or k_checks < 1:
             raise ValueError(
@@ -110,6 +111,11 @@ class FleetSupervisor:
         self.check_every = int(check_every)
         self.max_reforms = int(max_reforms)
         self._logger = logger or Logger()
+        # optional online-SLO signal (duck-typed like the admission
+        # controller's): while any declared SLO burns, the sick-check
+        # runs EVERY tick instead of every check_every — an alerting
+        # fleet earns a closer look, not a scheduled one
+        self.slo_monitor = slo_monitor
         self._health: Dict[str, _Health] = {}
         self._reform_attempts: Dict[str, int] = {}
         self._arc_id = 0
@@ -178,7 +184,9 @@ class FleetSupervisor:
         Replicas left DEAD/EVICTED by an earlier failed re-form get a
         fresh attempt here while their budget lasts — a transient
         allocation failure must not strand a replica forever."""
-        if fleet.tick % self.check_every != 0:
+        slo_burning = bool(self.slo_monitor is not None
+                           and getattr(self.slo_monitor, "firing", ()))
+        if fleet.tick % self.check_every != 0 and not slo_burning:
             return
         for replica in fleet.replicas:
             if replica.state == HEALTHY:

@@ -505,6 +505,16 @@ class PipelineStats:
     transfers_elided: int = 0
     compiles: int = 0
 
+    #: metric classification (telemetry.MetricsRegistry contract): the
+    #: model rebinds ``stats`` to a FRESH object every step, so every
+    #: field here is a per-step gauge — none accumulates across steps
+    FIELD_TYPES = {
+        "forward_s": "gauge", "backward_s": "gauge", "step_s": "gauge",
+        "loss": "gauge", "interleaved": "gauge", "dispatch_s": "gauge",
+        "compute_wait_s": "gauge", "transfers": "gauge",
+        "transfers_elided": "gauge", "compiles": "gauge",
+    }
+
     def snapshot(self) -> Dict[str, Any]:
         """JSON-able field dict — the ``ServingStats.snapshot()`` twin.
 

@@ -19,7 +19,7 @@ import jax
 from ..dynamics import ParameterServer, WorkerManager
 from ..ops import build_loss
 from ..parallel import PipelineModel
-from ..telemetry import MetricsRegistry, trace_span
+from ..telemetry import LiveMetricsMixin, MetricsRegistry, trace_span
 from ..utils import (
     DistributedTimer,
     Logger,
@@ -29,7 +29,7 @@ from ..utils import (
 from .hooks import Hook
 
 
-class Runner:
+class Runner(LiveMetricsMixin):
     def __init__(
         self,
         model: PipelineModel,
@@ -76,7 +76,16 @@ class Runner:
         # (the callable form survives the model rebinding `stats` to a
         # fresh PipelineStats every step)
         self.metrics = MetricsRegistry()
-        self.metrics.register("pipeline", lambda: self.model.stats.snapshot())
+        self.metrics.register(
+            "pipeline", lambda: self.model.stats.snapshot(),
+            types=getattr(type(getattr(self.model, "stats", None)),
+                          "FIELD_TYPES", None),
+        )
+        # live observability (LiveMetricsMixin: enable_timeseries /
+        # start_exporter — opt-in, zero-cost until enabled; the train
+        # loop samples the series once per iteration when attached)
+        self.timeseries = None
+        self._exporter = None
         self.data_loader = None
         # the in-flight (data, labels) pair, stashed for hooks that need a
         # representative batch (SelfHealHook probes stage times with it)
@@ -196,6 +205,15 @@ class Runner:
         self._preflight_done = True
         self._logger.info(f"pre-flight: {report.summary()}")
 
+    # --- live observability (LiveMetricsMixin provides the wiring) ----------
+    def _health_snapshot(self) -> Dict:
+        return dict(
+            status="aborted" if getattr(self, "aborted", False) else "ok",
+            epoch=self._epoch,
+            iter=self._iter,
+            max_iters=self._max_iters,
+        )
+
     # --- hooks --------------------------------------------------------------
     def register_hook(self, hook: Hook) -> None:
         assert isinstance(hook, Hook)
@@ -275,6 +293,8 @@ class Runner:
 
                 self._iter += 1
                 self._inner_iter += 1
+                if self.timeseries is not None:
+                    self.timeseries.sample()
                 self._call_hook("after_train_iter")
 
             if not exhausted:

@@ -20,7 +20,7 @@ from __future__ import annotations
 import itertools
 import time
 from dataclasses import dataclass, field
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -81,6 +81,15 @@ class Request:
     submitted_s: Optional[float] = None
     first_token_s: Optional[float] = None
     finished_s: Optional[float] = None
+
+    # request-scoped tracing scratch (tracer-relative µs marks for the
+    # segment currently open on this request's trace lane).  Lives ON
+    # the request because the request object is the one thing that
+    # survives preemption and cross-replica migration — whoever closes
+    # a segment (engine finish/preempt, fleet dead-drain) finds the
+    # open mark here.  Empty dict and never touched while tracing is
+    # disabled.
+    trace_marks: Dict[str, float] = field(default_factory=dict)
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)

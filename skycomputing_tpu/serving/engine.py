@@ -59,7 +59,7 @@ from ..parallel.pipeline import (
     device_put_elided,
     xla_compile_count,
 )
-from ..telemetry import MetricsRegistry, get_tracer
+from ..telemetry import LiveMetricsMixin, MetricsRegistry, get_tracer
 from .batcher import (
     AdmissionQueue,
     FINISHED,
@@ -135,6 +135,33 @@ class ServingStats:
     # per-request SLO samples
     ttft_s: List[float] = field(default_factory=list)
     tpot_s: List[float] = field(default_factory=list)
+
+    #: metric classification (telemetry.MetricsRegistry contract):
+    #: counters are cumulative for the ENGINE's lifetime and never
+    #: reset — ``reconfigure()`` preserves this object, and a fleet
+    #: replica's re-form (a new engine = a new lifetime) is bridged by
+    #: ``EngineReplica.stats_snapshot`` carrying the prior generations'
+    #: totals, so time-series rate derivation stays well-defined.
+    #: Covers ``snapshot()`` keys, derived fields included.
+    FIELD_TYPES = {
+        "iterations": "counter", "prefill_waves": "counter",
+        "prefill_tokens": "counter", "decode_tokens": "counter",
+        "generated_tokens": "counter", "admitted": "counter",
+        "finished": "counter", "preemptions": "counter",
+        "queue_stalls": "counter", "queue_rejections": "counter",
+        "compiles": "counter", "prefill_s": "counter",
+        "decode_s": "counter",
+        "queue_depth": "gauge", "batch_occupancy": "gauge",
+        "tokens_per_s": "gauge",
+        "ttft_p50_s": "gauge", "ttft_p95_s": "gauge",
+        "tpot_p50_s": "gauge", "tpot_p95_s": "gauge",
+    }
+
+    #: the cumulative subset a replica carries across re-forms
+    COUNTER_FIELDS = tuple(
+        k for k, v in FIELD_TYPES.items()
+        if v == "counter"
+    )
 
     def tokens_per_s(self) -> float:
         """Generated tokens per second of engine compute wall clock."""
@@ -271,7 +298,7 @@ class _ServingStage:
         return SlotKVCachePool(self.specs, num_slots, device=self.device)
 
 
-class ServingEngine:
+class ServingEngine(LiveMetricsMixin):
     """Continuous-batching GPT serving over allocator-placed stages.
 
     ``model_cfg`` is the same layer-config list every other subsystem
@@ -345,7 +372,17 @@ class ServingEngine:
         # same snapshot() contract as the training runner's registry, so
         # one poller reads either subsystem identically
         self.metrics = MetricsRegistry()
-        self.metrics.register("serving", lambda: self.stats.snapshot())
+        self.metrics.register("serving", lambda: self.stats.snapshot(),
+                              types=ServingStats.FIELD_TYPES)
+        # trace attribution name for request-scoped spans; a fleet
+        # replica overwrites this with its replica name so a migrated
+        # request's waterfall says WHERE each segment ran
+        self.trace_name = "engine"
+        # live observability (LiveMetricsMixin: enable_timeseries /
+        # start_exporter — opt-in, zero-cost until enabled; step()
+        # samples the series when one is attached)
+        self.timeseries = None
+        self._exporter = None
         self._running: Dict[int, Request] = {}  # request_id -> Request
         self._finished: List[Request] = []
         # closed-loop tuning: when set (tuning.ServingAutotuner attaches
@@ -461,6 +498,64 @@ class ServingEngine:
         for st in self.stages:
             st.pool.release(slot)
 
+    # --- request-scoped tracing ---------------------------------------------
+    # One stable id (request_id) threads the whole waterfall: every
+    # segment span lands on the request's recycled trace lane with a
+    # {"request", "replica"} attribution, and the open-segment mark
+    # lives on the Request object itself so whoever ends the segment —
+    # this engine, another engine after a migration, or the fleet over
+    # a dead replica — can close it.  All helpers are no-ops when
+    # tracing is disabled (tracer is None).
+
+    def _trace_queued(self, request: Request, tracer) -> None:
+        """Open a ``queue_wait`` segment (mark + ``queued`` instant)."""
+        if tracer is None:
+            return
+        request.trace_marks["queued"] = tracer.now()
+        lane = tracer.request_lane(request.request_id)
+        if lane is not None:
+            tracer.instant(
+                "queued", lane,
+                {"request": request.request_id,
+                 "replica": self.trace_name},
+            )
+
+    def _trace_close_queue(self, request: Request, tracer,
+                           end_us: Optional[float] = None,
+                           **extra) -> None:
+        """Close the open ``queue_wait`` segment, if any."""
+        if tracer is None:
+            return
+        mark = request.trace_marks.pop("queued", None)
+        if mark is None:
+            return
+        lane = tracer.request_lane(request.request_id, lease=False)
+        if lane is None:
+            return
+        end = tracer.now() if end_us is None else end_us
+        args = {"request": request.request_id,
+                "replica": self.trace_name}
+        args.update(extra)
+        tracer.complete("queue_wait", lane, mark, args,
+                        dur_us=end - mark)
+
+    def _trace_close_decode(self, request: Request, tracer,
+                            **extra) -> None:
+        """Close the open ``decode`` segment, if any."""
+        if tracer is None:
+            return
+        mark = request.trace_marks.pop("decode", None)
+        if mark is None:
+            return
+        lane = tracer.request_lane(request.request_id, lease=False)
+        if lane is None:
+            return
+        args = {"request": request.request_id,
+                "replica": self.trace_name,
+                "tokens": len(request.tokens)}
+        args.update(extra)
+        tracer.complete("decode", lane, mark, args)
+
     # --- request lifecycle --------------------------------------------------
     def submit(self, request: Request, *, force: bool = False) -> Request:
         """Queue a request (admitted into a slot on a later ``step``).
@@ -488,12 +583,12 @@ class ServingEngine:
                 f"prompt ({length}) + new tokens ({request.remaining}) "
                 f"exceed max_len={self.max_len}"
             )
+        tracer = get_tracer()
         try:
             # raises QueueFullError on a full bounded queue (unless
             # forced) and ValueError if no bucket fits
             self._queue.submit(request, force=force)
         except QueueFullError:
-            tracer = get_tracer()
             if self.queue_policy == "shed":
                 # shed until the newcomer fits: force re-queues
                 # (preemption/reconfigure/migration) may have pushed the
@@ -515,10 +610,22 @@ class ServingEngine:
                             {"shed": shed.request_id,
                              "admitted": request.request_id},
                         )
+                        self._trace_close_queue(shed, tracer,
+                                                shed=True)
+                        lane = tracer.request_lane(
+                            shed.request_id, lease=False)
+                        if lane is not None:
+                            tracer.instant(
+                                "shed", lane,
+                                {"request": shed.request_id,
+                                 "replica": self.trace_name},
+                            )
+                        tracer.release_request_lane(shed.request_id)
                 if self._queue.depth < (self.max_queue or 0):
                     self._queue.submit(request)
                     self.stats.admitted += 1
                     self.stats.queue_depth = self._queue.depth
+                    self._trace_queued(request, tracer)
                     return request
             self.stats.queue_rejections += 1
             if tracer is not None:
@@ -530,6 +637,7 @@ class ServingEngine:
             raise
         self.stats.admitted += 1
         self.stats.queue_depth = self._queue.depth
+        self._trace_queued(request, tracer)
         return request
 
     def preempt(self, request_id: int) -> Request:
@@ -553,10 +661,16 @@ class ServingEngine:
                 "preempt", tracer.lane("serving", "engine"),
                 {"request": request_id},
             )
+            # the request's decode segment ends here (the engine-lane
+            # preempt instant above already carries the request id, so
+            # the timeline keeps its marker without a duplicate that
+            # would double trace-derived preemption counts)
+            self._trace_close_decode(request, tracer, preempted=True)
         # force: the queue bound gates NEW admissions only — a preempted
         # request is already admitted and dropping it loses its tokens
         self._queue.submit(request, force=True)
         self.stats.queue_depth = self._queue.depth
+        self._trace_queued(request, tracer)
         return request
 
     def drain(self) -> List[Request]:
@@ -577,6 +691,13 @@ class ServingEngine:
             except ValueError:
                 continue  # documented: not resumable, stays running
         drained = self._queue.drain()
+        tracer = get_tracer()
+        if tracer is not None:
+            # each drained request's queue_wait segment ends HERE (on
+            # this engine); re-submission elsewhere opens a fresh one —
+            # the migration gap stays visible, never an orphaned mark
+            for r in drained:
+                self._trace_close_queue(r, tracer, drained=True)
         self.stats.queue_depth = 0
         return drained
 
@@ -604,6 +725,21 @@ class ServingEngine:
             self.stats.ttft_s.append(ttft)
         if tpot is not None:
             self.stats.tpot_s.append(tpot)
+        tracer = get_tracer()
+        if tracer is not None:
+            # terminal: close the decode segment, stamp the finish, and
+            # recycle the request's lane for the next live request
+            self._trace_close_decode(request, tracer)
+            lane = tracer.request_lane(request.request_id,
+                                       lease=False)
+            if lane is not None:
+                tracer.instant(
+                    "finish", lane,
+                    {"request": request.request_id,
+                     "replica": self.trace_name,
+                     "tokens": len(request.tokens)},
+                )
+            tracer.release_request_lane(request.request_id)
 
     # --- the continuous-batching loop ---------------------------------------
     def has_work(self) -> bool:
@@ -627,6 +763,8 @@ class ServingEngine:
         self.stats.iterations += 1
         self.stats.queue_depth = self._queue.depth
         self.stats.batch_occupancy = self.stages[0].pool.occupancy
+        if self.timeseries is not None:
+            self.timeseries.sample()
         if self.autotuner is not None:
             self.autotuner.on_step(self)
 
@@ -756,7 +894,12 @@ class ServingEngine:
                         "preempt", tracer.lane("serving", "engine"),
                         {"request": r.request_id, "reconfigure": True},
                     )
+                    self._trace_close_decode(r, tracer,
+                                             reconfigure=True)
         queued = self._queue.drain()
+        if tracer is not None:
+            for r in queued:
+                self._trace_close_queue(r, tracer, rebucketed=True)
         if new_pools is not None:
             self.num_slots = new_slots
             for st, pool in zip(self.stages, new_pools):
@@ -771,6 +914,7 @@ class ServingEngine:
         # reconfigure must never shed what it only meant to re-bucket
         for r in evicted + queued:
             self._queue.submit(r, force=True)
+            self._trace_queued(r, tracer)
         self.stats.queue_depth = self._queue.depth
         if tracer is not None:
             tracer.instant(
@@ -809,6 +953,16 @@ class ServingEngine:
     @property
     def finished_requests(self) -> List[Request]:
         return list(self._finished)
+
+    # --- live observability (LiveMetricsMixin provides the wiring) ----------
+    def _health_snapshot(self) -> Dict[str, Any]:
+        return dict(
+            status="ok",
+            queue_depth=self._queue.depth,
+            running=len(self._running),
+            free_slots=self.free_slots,
+            iterations=self.stats.iterations,
+        )
 
     # --- internals ----------------------------------------------------------
     def _admit(self) -> None:
@@ -863,19 +1017,37 @@ class ServingEngine:
         self.stats.prefill_s += now - t0
         wave_tokens = int(lengths[: len(wave)].sum())
         if tracer is not None:
+            end_us = tracer.now()
             # tokens (true, un-padded) ride along so trace analysis can
             # compute per-bucket padding waste — the skewed-bucket
-            # signature the autotuner acts on
+            # signature the autotuner acts on; the member request ids
+            # make the wave attributable from the engine lane too
             tracer.complete(
                 "prefill", tracer.lane("serving", "engine"), span0,
                 {"bucket": bucket, "wave": len(wave),
-                 "tokens": wave_tokens},
+                 "tokens": wave_tokens,
+                 "requests": [r.request_id for r in wave]},
+                dur_us=end_us - span0,
             )
             for r in wave:
                 tracer.instant(
                     "admit", tracer.lane("serving", "engine"),
                     {"request": r.request_id, "slot": r.slot},
                 )
+                # request-lane waterfall: the queue_wait segment ends
+                # where the wave began, the prefill segment spans the
+                # wave, and the decode segment opens at the wave's end
+                self._trace_close_queue(r, tracer, end_us=span0)
+                lane = tracer.request_lane(r.request_id, lease=False)
+                if lane is not None:
+                    tracer.complete(
+                        "prefill", lane, span0,
+                        {"request": r.request_id,
+                         "replica": self.trace_name,
+                         "bucket": bucket, "slot": r.slot},
+                        dur_us=end_us - span0,
+                    )
+                r.trace_marks["decode"] = end_us
         self.stats.prefill_waves += 1
         self.stats.prefill_tokens += wave_tokens
         # per-call delta, not a process-global diff: foreign jit work in

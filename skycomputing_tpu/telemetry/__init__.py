@@ -1,12 +1,31 @@
-"""Unified telemetry: span tracer, Chrome-trace export, metrics registry.
+"""Unified telemetry: span tracer, metrics registry, live observability.
 
 Pure stdlib — importable from every layer (parallel, runner, dynamics,
-serving, tools) without pulling jax, and cheap enough to leave wired in
-production code paths permanently (disabled tracing is a ``None`` check).
+serving, fleet, tools) without pulling jax, and cheap enough to leave
+wired in production code paths permanently (disabled tracing is a
+``None`` check; an un-started exporter binds nothing).
+
+- :mod:`.tracer` — span tracer + Chrome-trace export (+ recycled
+  per-request lanes for end-to-end request waterfalls);
+- :mod:`.metrics` — the one ``snapshot()`` contract over every stats
+  surface, with counter/gauge field classification and per-source
+  error isolation;
+- :mod:`.timeseries` — bounded ring-buffered sampling with derived
+  rates and windowed percentiles;
+- :mod:`.exporter` — opt-in ``http.server`` endpoint: ``/metrics``
+  (Prometheus text), ``/metrics.json``, ``/healthz``;
+- :mod:`.slo` — declared SLO targets evaluated as multi-window burn
+  rates, emitting ``slo_alert`` trace instants and a registry source;
+- :mod:`.analysis` — trace analysis library (bubble/critical-path/
+  serving breakdowns, per-request timeline reconstruction).
 """
 
 from . import analysis
+from .exporter import MetricsExporter
+from .live import LiveMetricsMixin
 from .metrics import MetricsRegistry
+from .slo import SloAlert, SloMonitor, SloTarget
+from .timeseries import MetricsTimeseries
 from .tracer import (
     Tracer,
     disable_tracing,
@@ -17,7 +36,13 @@ from .tracer import (
 
 __all__ = [
     "analysis",
+    "LiveMetricsMixin",
+    "MetricsExporter",
     "MetricsRegistry",
+    "MetricsTimeseries",
+    "SloAlert",
+    "SloMonitor",
+    "SloTarget",
     "Tracer",
     "disable_tracing",
     "enable_tracing",

@@ -350,6 +350,114 @@ def serving_padding_fraction(
 
 
 # --------------------------------------------------------------------------
+# request-scoped timeline reconstruction
+# --------------------------------------------------------------------------
+
+#: span names the request lanes emit, one per waterfall segment kind
+REQUEST_SEGMENT_NAMES = ("queue_wait", "prefill", "decode")
+#: instants that end a request's story (nothing more may follow)
+REQUEST_TERMINAL_NAMES = ("finish", "failed", "rejected", "shed")
+
+
+def request_timeline(events: List[Dict[str, Any]],
+                     request_id: int) -> Dict[str, Any]:
+    """One request's end-to-end waterfall from a Chrome trace.
+
+    Selects every event whose args carry ``request == request_id`` —
+    the request-lane ``queue_wait``/``prefill``/``decode`` spans plus
+    lifecycle instants (``submitted``/``queued``/``dispatch``/
+    ``admit``/``preempt``/``migrate``/``limbo``/``finish``/...) — and
+    orders them into segments with per-segment replica attribution.
+    A migrated request reads as: segments on replica A, a ``migrate``
+    marker, segments on replica B — one id, one timeline.
+
+    Returns ``segments`` (spans, time-ordered), ``markers``
+    (instants), ``replicas`` (distinct attribution, first-seen order),
+    ``migrations``, ``complete`` (reached a terminal marker),
+    ``orphan_spans`` (spans that start after the terminal marker —
+    zero in a well-formed trace), and ``max_gap_ms`` between adjacent
+    segments.
+    """
+    rid = request_id
+    spans: List[Dict[str, Any]] = []
+    markers: List[Dict[str, Any]] = []
+    for ev in events:
+        args = ev.get("args") or {}
+        if args.get("request") != rid:
+            continue
+        if (ev.get("ph") == "X"
+                and ev.get("name") in REQUEST_SEGMENT_NAMES):
+            t0 = float(ev["ts"])
+            t1 = t0 + float(ev.get("dur", 0))
+            spans.append({
+                "name": ev["name"],
+                "start_ms": t0 / 1e3,
+                "end_ms": t1 / 1e3,
+                "duration_ms": (t1 - t0) / 1e3,
+                "replica": args.get("replica"),
+                "args": {k: v for k, v in args.items()
+                         if k != "request"},
+            })
+        elif ev.get("ph") == "i":
+            markers.append({
+                "name": ev["name"],
+                "ts_ms": float(ev["ts"]) / 1e3,
+                "replica": args.get("replica") or args.get("from"),
+                "args": {k: v for k, v in args.items()
+                         if k != "request"},
+            })
+    if not spans and not markers:
+        raise TraceError(f"no events carry request id {rid}")
+    spans.sort(key=lambda s: (s["start_ms"], s["end_ms"]))
+    markers.sort(key=lambda m: m["ts_ms"])
+    replicas: List[str] = []
+    for item in sorted(spans + markers,
+                       key=lambda x: x.get("start_ms", x.get("ts_ms"))):
+        rep = item.get("replica")
+        if rep and rep not in replicas:
+            replicas.append(rep)
+    terminal = [m for m in markers
+                if m["name"] in REQUEST_TERMINAL_NAMES]
+    end_of_story = terminal[-1]["ts_ms"] if terminal else None
+    orphans = (
+        [s for s in spans if s["start_ms"] > end_of_story + 1e-6]
+        if end_of_story is not None else []
+    )
+    gaps = [
+        max(0.0, b["start_ms"] - a["end_ms"])
+        for a, b in zip(spans, spans[1:])
+    ]
+    points = ([s["start_ms"] for s in spans]
+              + [s["end_ms"] for s in spans]
+              + [m["ts_ms"] for m in markers])
+    return {
+        "request": rid,
+        "segments": spans,
+        "markers": markers,
+        "replicas": replicas,
+        "migrations": sum(1 for m in markers if m["name"] == "migrate"),
+        "preemptions": sum(1 for m in markers
+                           if m["name"] == "preempt"),
+        "complete": bool(terminal),
+        "terminal": terminal[-1]["name"] if terminal else None,
+        "orphan_spans": len(orphans),
+        "max_gap_ms": round(max(gaps), 3) if gaps else 0.0,
+        "start_ms": min(points),
+        "end_ms": max(points),
+    }
+
+
+def request_ids(events: List[Dict[str, Any]]) -> List[int]:
+    """Every distinct request id appearing in the trace's args."""
+    seen = set()
+    for ev in events:
+        rid = (ev.get("args") or {}).get("request")
+        if isinstance(rid, int):
+            seen.add(rid)
+    return sorted(seen)
+
+
+# --------------------------------------------------------------------------
 # regression gate
 # --------------------------------------------------------------------------
 
@@ -427,6 +535,8 @@ def check_regression(
 
 
 __all__ = [
+    "REQUEST_SEGMENT_NAMES",
+    "REQUEST_TERMINAL_NAMES",
     "TraceError",
     "analyze",
     "baseline_targets",
@@ -438,6 +548,8 @@ __all__ = [
     "measured_stage_seconds",
     "merge_intervals",
     "named_durations",
+    "request_ids",
+    "request_timeline",
     "serving_padding_fraction",
     "stage_spans",
 ]

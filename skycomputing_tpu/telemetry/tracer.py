@@ -108,7 +108,8 @@ class Tracer:
     """
 
     def __init__(self, capacity: int = _DEFAULT_CAPACITY,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 request_lanes: int = 32):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = int(capacity)
@@ -117,10 +118,22 @@ class Tracer:
         # event tuples: (ph, name, ts_us, dur_us, pid, tid, args, async_id)
         self._events: deque = deque(maxlen=self.capacity)
         self.dropped = 0
-        self._lock = threading.Lock()
+        # RLock: request_lane() registers through lane() under the lock
+        self._lock = threading.RLock()
         self._lanes: Dict[Tuple[str, str], Lane] = {}
         self._pids: Dict[str, int] = {}
         self._tid_next: Dict[int, int] = {}
+        # request-scoped lanes: a bounded pool of rows under one
+        # "requests" process, leased per live request id and RECYCLED
+        # when the request reaches a terminal state — "millions of
+        # users" must not mean millions of Chrome thread rows.  Beyond
+        # the cap, request_lane() returns None and instrumentation
+        # falls back to args-only attribution (the timeline is still
+        # reconstructable by request id).
+        self.request_lanes = int(request_lanes)
+        self._req_lanes: Dict[Any, Lane] = {}
+        self._req_free: List[Lane] = []
+        self._req_created = 0
 
     # --- clock --------------------------------------------------------------
     def now(self) -> float:
@@ -150,6 +163,48 @@ class Tracer:
                 got = (pid, tid)
                 self._lanes[key] = got
         return got
+
+    def request_lane(self, request_id: Any,
+                     lease: bool = True) -> Optional[Lane]:
+        """The recycled per-request lane for a live request id, or
+        ``None`` when the pool (``request_lanes``) is exhausted.
+
+        The same id always maps to the same lane until
+        :meth:`release_request_lane` returns it to the pool, so one
+        request's whole waterfall — across engines, across a
+        mid-stream migration — renders on one Perfetto row.
+
+        ``lease=False`` only looks up an EXISTING lease.  Mid-request
+        instrumentation (segment closes, terminal markers) must peek,
+        never lease: under pool exhaustion a request that started
+        without a lane would otherwise grab a lane freed by a later
+        terminal request and emit retroactive spans overlapping the
+        previous tenant's on the same row.
+        """
+        got = self._req_lanes.get(request_id)
+        if got is not None or not lease:
+            return got
+        with self._lock:
+            got = self._req_lanes.get(request_id)
+            if got is not None:
+                return got
+            if self._req_free:
+                lane = self._req_free.pop()
+            elif self._req_created < self.request_lanes:
+                self._req_created += 1
+                lane = self.lane("requests", f"lane {self._req_created}")
+            else:
+                return None
+            self._req_lanes[request_id] = lane
+            return lane
+
+    def release_request_lane(self, request_id: Any) -> None:
+        """Return a terminal request's lane to the pool (no-op for ids
+        that never leased one)."""
+        with self._lock:
+            lane = self._req_lanes.pop(request_id, None)
+            if lane is not None:
+                self._req_free.append(lane)
 
     # --- recording ----------------------------------------------------------
     def _append(self, ev: tuple) -> None:
@@ -294,7 +349,8 @@ def get_tracer() -> Optional[Tracer]:
 
 
 def enable_tracing(capacity: int = _DEFAULT_CAPACITY,
-                   clock: Callable[[], float] = time.monotonic) -> Tracer:
+                   clock: Callable[[], float] = time.monotonic,
+                   request_lanes: int = 32) -> Tracer:
     """Install (or return the already-active) process-global tracer.
 
     Idempotent by design: a ``TraceHook`` and a serving engine in one
@@ -303,7 +359,8 @@ def enable_tracing(capacity: int = _DEFAULT_CAPACITY,
     directly.
     """
     if _STATE[0] is None:
-        _STATE[0] = Tracer(capacity=capacity, clock=clock)
+        _STATE[0] = Tracer(capacity=capacity, clock=clock,
+                           request_lanes=request_lanes)
     return _STATE[0]
 
 

@@ -11,6 +11,8 @@ hint, never silently dropped.  Chaos is scripted through the seeded
 ``FaultPlan`` fleet vocabulary so each scenario replays exactly.
 """
 
+import json
+
 import numpy as np
 import pytest
 
@@ -529,6 +531,294 @@ def test_fleet_shed_under_overload_is_counted_never_silent(gpt, devices):
     for r, d in zip(batch + interactive, decisions + keep):
         if not d.admitted:
             assert r.status == "rejected"
+
+
+# --------------------------------------------------------------------------
+# fleet observability plane (request tracing, exporter, SLO monitor)
+# --------------------------------------------------------------------------
+
+
+def test_migrated_request_trace_single_id_no_orphans(gpt, devices):
+    """One request id threads the whole waterfall across a replica
+    kill: segments on the dead replica, a migrate marker, segments on
+    the survivor — complete, ordered, zero orphaned spans."""
+    from skycomputing_tpu import telemetry
+    from skycomputing_tpu.telemetry.analysis import (
+        request_ids,
+        request_timeline,
+    )
+
+    layer_cfgs, params, fwd = gpt
+    plan = FaultPlan(
+        [dict(iter=6, kind="replica_crash", replica=0)], seed=0
+    )
+    fleet = ServingFleet(
+        layer_cfgs, params, replicas=3,
+        engine_kwargs=dict(num_slots=2, max_len=64, buckets=(8, 16)),
+        supervisor=fast_supervisor(),
+        fault_injector=FleetFaultInjector(plan),
+        devices=devices,
+    )
+    rng = np.random.default_rng(5)
+    requests = mixed_requests(
+        rng,
+        [(5, 9), (3, 6), (12, 7), (7, 5), (16, 6), (2, 11), (6, 8),
+         (9, 4)],
+    )
+    tracer = telemetry.enable_tracing()
+    try:
+        outputs = fleet.run(requests)
+        events = tracer.to_chrome()["traceEvents"]
+    finally:
+        telemetry.disable_tracing()
+    assert_identity(fwd, requests, outputs)
+    assert fleet.stats.migrations > 0
+
+    migrated = []
+    for rid in request_ids(events):
+        timeline = request_timeline(events, rid)
+        # EVERY request's trace is complete with no orphaned spans
+        assert timeline["complete"], f"request {rid} has no terminal"
+        assert timeline["orphan_spans"] == 0
+        for a, b in zip(timeline["segments"],
+                        timeline["segments"][1:]):
+            assert b["start_ms"] >= a["start_ms"]
+        if timeline["migrations"] >= 1:
+            migrated.append(timeline)
+    assert migrated, "the kill must migrate at least one request"
+    timeline = migrated[0]
+    # one id, two replicas, and the full phase vocabulary on each side
+    assert len(timeline["replicas"]) >= 2
+    names = [s["name"] for s in timeline["segments"]]
+    assert names.count("prefill") >= 2 and names.count("decode") >= 2
+    by_replica = {}
+    for seg in timeline["segments"]:
+        by_replica.setdefault(seg["replica"], []).append(seg["name"])
+    for replica, segs in by_replica.items():
+        assert "prefill" in segs or "queue_wait" in segs
+    # the interrupted decode is attributed to the DEAD replica, and
+    # every segment after the migrate marker belongs to a survivor
+    migrate_ts = [m["ts_ms"] for m in timeline["markers"]
+                  if m["name"] == "migrate"][0]
+    dead_name = [m for m in timeline["markers"]
+                 if m["name"] == "migrate"][0]["replica"]
+    for seg in timeline["segments"]:
+        if seg["start_ms"] > migrate_ts:
+            assert seg["replica"] != dead_name
+    # lanes recycled: nothing still leased after the fleet drained
+    assert tracer._req_lanes == {}
+
+
+def test_fleet_observability_e2e_demo(gpt, devices):
+    """The acceptance scenario: replica crash + latency spike under a
+    seeded FaultPlan, with the exporter serving live counters over
+    HTTP, trace_report --request reconstructing a migrated request's
+    waterfall from the written trace file, and the SLO monitor firing
+    a slo_alert that is visible in the Chrome trace AND the registry
+    snapshot."""
+    import urllib.request
+
+    from skycomputing_tpu import telemetry
+    from skycomputing_tpu.telemetry import SloMonitor, SloTarget
+    from skycomputing_tpu.telemetry.analysis import (
+        load_events,
+        request_ids,
+        request_timeline,
+    )
+    from tools.trace_report import main as report_main
+
+    layer_cfgs, params, fwd = gpt
+    plan = FaultPlan(
+        [dict(iter=6, kind="replica_crash", replica=0),
+         dict(iter=14, kind="latency_spike", replica=1, seconds=0.25,
+              duration=3)],
+        seed=0,
+    )
+    fleet = ServingFleet(
+        layer_cfgs, params, replicas=3,
+        engine_kwargs=dict(num_slots=2, max_len=64, buckets=(8, 16)),
+        # sick detection OFF (huge threshold): the spike must BURN the
+        # SLO rather than be healed away before the monitor sees it
+        supervisor=fast_supervisor(sick_threshold=1e9),
+        fault_injector=FleetFaultInjector(plan),
+        devices=devices,
+        slo=SloMonitor([
+            SloTarget(name="tpot_p95", metric="fleet.tpot_p95_s",
+                      threshold=0.05, budget=0.25, fast_window=1,
+                      slow_window=4),
+            SloTarget(name="heal_budget",
+                      metric="fleet.reform_failures",
+                      threshold=100.0, kind="rate", fast_window=1,
+                      slow_window=8),
+        ]),
+    )
+    # the monitor is wired as the optional signal on both consumers
+    assert fleet.admission.slo_monitor is fleet.slo
+    assert fleet.supervisor.slo_monitor is fleet.slo
+    assert "slo" in fleet.metrics
+    exporter = fleet.start_exporter()
+    rng = np.random.default_rng(12)
+    requests = mixed_requests(
+        rng,
+        [(5, 16), (3, 14), (12, 12), (7, 15), (16, 13), (2, 17),
+         (6, 12), (9, 14)],
+    )
+    import tempfile
+
+    tracer = telemetry.enable_tracing()
+    try:
+        outputs = fleet.run(requests)
+        with tempfile.TemporaryDirectory() as tmp:
+            trace_path = tracer.write(f"{tmp}/fleet.trace.json")
+            telemetry.disable_tracing()
+
+            # 1. every accepted request still finishes token-identical
+            assert_identity(fwd, requests, outputs)
+            assert fleet.stats.migrations > 0
+            assert fleet.stats.reforms >= 1
+
+            # 2. the exporter's /metrics shows the fleet's live
+            #    counters (and the SLO source) over real HTTP
+            with urllib.request.urlopen(
+                f"{exporter.url}/metrics", timeout=5
+            ) as response:
+                body = response.read().decode()
+            assert "# TYPE skytpu_fleet_submitted counter" in body
+            assert f"skytpu_fleet_submitted {len(requests)}" in body
+            assert "skytpu_fleet_migrations" in body
+            assert "skytpu_replica0_finished" in body
+            assert "skytpu_slo_alerts_total" in body
+            with urllib.request.urlopen(
+                f"{exporter.url}/healthz", timeout=5
+            ) as response:
+                health = json.loads(response.read().decode())
+            assert set(health["replicas"]) == {
+                "replica0", "replica1", "replica2"
+            }
+            assert health["status"] in ("ok", "degraded")
+
+            # 3. the SLO monitor fired during the spike: visible in the
+            #    Chrome trace AND the registry snapshot
+            events = load_events(trace_path)
+            alerts = [ev for ev in events
+                      if ev.get("name") == "slo_alert"]
+            assert alerts, "the latency spike must burn the TPOT SLO"
+            assert alerts[0]["args"]["target"] == "tpot_p95"
+            snap = fleet.metrics.snapshot()
+            assert snap["slo"]["alerts_total"] >= 1
+            assert "tpot_p95" in fleet.slo.fired_ever
+            assert "heal_budget" not in fleet.slo.fired_ever
+            # the time-series behind it recorded the whole run
+            assert fleet.timeseries.samples == fleet.stats.ticks
+            assert fleet.timeseries.latest("fleet.migrations") \
+                == fleet.stats.migrations
+
+            # 4. trace_report --request reconstructs a migrated
+            #    request's full waterfall from the written file
+            migrated_ids = [
+                rid for rid in request_ids(events)
+                if request_timeline(events, rid)["migrations"] >= 1
+            ]
+            assert migrated_ids
+            timeline = request_timeline(events, migrated_ids[0])
+            assert timeline["complete"]
+            assert timeline["orphan_spans"] == 0
+            assert len(timeline["replicas"]) >= 2
+            assert report_main(
+                [trace_path, "--request", str(migrated_ids[0])]
+            ) == 0
+    finally:
+        telemetry.disable_tracing()
+        fleet.stop_exporter()
+
+
+def test_slo_firing_tightens_admission_and_supervisor(gpt, devices):
+    """The control couplings: a firing monitor halves the pending
+    bound (visible in the decision detail) and makes the supervisor
+    check every tick regardless of check_every."""
+
+    class _FakeMonitor:
+        firing = ("ttft",)
+
+    adm = AdmissionController(max_pending=8)
+    assert adm.pending_bound(0) == 8
+    adm.slo_monitor = _FakeMonitor()
+    assert adm.pending_bound(0) == 4  # slo_tighten=0.5 default
+    decision = adm.decide(pending=4, capacity_slots=4)
+    assert not decision.admitted and decision.reason == QUEUE_FULL
+    assert decision.detail["slo_tightened"] is True
+    adm.slo_monitor = None
+    assert adm.decide(pending=4, capacity_slots=4,
+                      priority="interactive").admitted
+    # factor-scaled bounds tighten too, and never to zero
+    auto = AdmissionController(queue_factor=2.0,
+                               slo_monitor=_FakeMonitor(),
+                               slo_tighten=0.25)
+    assert auto.pending_bound(8) == 4
+    assert auto.pending_bound(0) == 1
+    with pytest.raises(ValueError, match="slo_tighten"):
+        AdmissionController(slo_tighten=0.0)
+
+    # supervisor: check_every=1000 would normally skip every poll;
+    # the firing monitor forces the look, catching the dead replica
+    layer_cfgs, params, fwd = gpt
+    fleet = ServingFleet(
+        layer_cfgs, params, replicas=2,
+        engine_kwargs=dict(num_slots=2, max_len=64, buckets=(8, 16)),
+        supervisor=fast_supervisor(check_every=1000),
+        fault_injector=FleetFaultInjector(FaultPlan(
+            [dict(iter=2, kind="replica_crash", replica=0)], seed=0
+        )),
+        devices=devices,
+    )
+    fleet.supervisor.slo_monitor = _FakeMonitor()
+    rng = np.random.default_rng(13)
+    requests = mixed_requests(rng, [(5, 8), (3, 6), (7, 7), (6, 5)])
+    outputs = fleet.run(requests)
+    assert_identity(fwd, requests, outputs)
+    assert fleet.stats.reforms == 1  # caught despite check_every=1000
+
+
+def test_replica_counters_stay_monotonic_across_reform(gpt, devices):
+    """The fleet registry's per-replica source never shows a counter
+    reset: a re-formed replica's fresh engine starts at zero, but
+    stats_snapshot carries the prior generation's totals forward."""
+    layer_cfgs, params, fwd = gpt
+    fleet = ServingFleet(
+        layer_cfgs, params, replicas=2,
+        engine_kwargs=dict(num_slots=2, max_len=64, buckets=(8, 16)),
+        supervisor=fast_supervisor(),
+        fault_injector=FleetFaultInjector(FaultPlan(
+            [dict(iter=5, kind="replica_crash", replica=0)], seed=0
+        )),
+        devices=devices,
+    )
+    ts = fleet.enable_timeseries(window=512)
+    rng = np.random.default_rng(14)
+    requests = mixed_requests(
+        rng, [(5, 12), (3, 10), (7, 11), (6, 9), (9, 10), (4, 8)]
+    )
+    outputs = fleet.run(requests)
+    assert_identity(fwd, requests, outputs)
+    assert fleet.replicas[0].generation == 1
+    # the engine reset, the replica's registered source did not
+    rep = fleet.replicas[0]
+    carried = rep._carried
+    assert carried["iterations"] > 0
+    snap = rep.stats_snapshot()
+    assert snap["iterations"] == (carried["iterations"]
+                                  + rep.engine.stats.iterations)
+    assert snap["generation"] == 1
+    # every sampled counter series is non-decreasing through the heal
+    from skycomputing_tpu.serving.engine import ServingStats
+
+    for field in ("iterations", "decode_tokens", "generated_tokens"):
+        assert ServingStats.FIELD_TYPES[field] == "counter"
+        values = ts.values(f"replica0.{field}")
+        assert values, f"no samples for replica0.{field}"
+        assert all(b >= a for a, b in zip(values, values[1:])), (
+            f"replica0.{field} went backwards across the re-form"
+        )
 
 
 # --------------------------------------------------------------------------

@@ -486,6 +486,495 @@ def test_metrics_hook_restart_and_crash_semantics(devices, tmp_path):
     assert headers[0]["config_hash"] != headers[1]["config_hash"]
 
 
+# --------------------------------------------------------------------------
+# live observability plane: timeseries, exporter, SLO monitor
+# --------------------------------------------------------------------------
+
+
+def test_metrics_registry_isolates_raising_sources():
+    """One broken source lands in __errors__; the others still report.
+    A non-dict RETURN (contract violation) still raises."""
+    registry = MetricsRegistry()
+    registry.register("good", lambda: {"x": 1})
+    registry.register("boom", lambda: (_ for _ in ()).throw(
+        RuntimeError("probe died")))
+    snap = registry.snapshot()
+    assert snap["good"] == {"x": 1}
+    assert "boom" not in snap
+    assert "RuntimeError: probe died" in snap["__errors__"]["boom"]
+    # the reserved name cannot be taken by a real source
+    with pytest.raises(ValueError, match="reserved"):
+        registry.register("__errors__", lambda: {})
+    # the non-dict contract violation still raises (not isolated)
+    registry.register("broken", lambda: [1, 2])
+    with pytest.raises(TypeError, match="expected dict"):
+        registry.snapshot()
+
+
+def test_timeseries_ring_bounds_rates_and_percentiles():
+    from skycomputing_tpu.telemetry import MetricsTimeseries
+
+    state = {"count": 0, "level": 0.0}
+    registry = MetricsRegistry()
+    registry.register(
+        "src", lambda: dict(count=state["count"], level=state["level"],
+                            by_reason={"a": state["count"] * 2}),
+        types={"count": "counter", "level": "gauge",
+               "by_reason": "counter"},
+    )
+    clock = FakeClock()
+    ts = MetricsTimeseries(registry, window=8, clock=clock)
+    for i in range(20):
+        clock.t += 0.5
+        state["count"] += 3          # 6/s
+        state["level"] = float(i)
+        ts.sample()
+    # ring bound: only the newest 8 samples survive per key
+    assert len(ts.series("src.count")) == 8
+    assert ts.samples == 20
+    # counter rate is exact under the fake clock (6 per second)
+    assert ts.rate("src.count") == pytest.approx(6.0)
+    # nested dicts flatten one level and inherit the parent's type
+    assert ts.type_of("src.by_reason.a") == "counter"
+    assert ts.rate("src.by_reason.a") == pytest.approx(12.0)
+    # gauge percentiles over the window (levels 12..19 survive)
+    assert ts.percentile("src.level", 50) == pytest.approx(16.0)
+    assert ts.percentile("src.level", 95) == pytest.approx(19.0)
+    assert ts.latest("src.level") == 19.0
+    # a counter RESET (re-formed replica) must not go negative: the
+    # positive-delta sum ignores the reset edge
+    state["count"] = 0
+    clock.t += 0.5
+    ts.sample()
+    rate = ts.rate("src.count", window=3)
+    assert rate is not None and rate >= 0.0
+    # no rate before two samples / while time stands still
+    ts2 = MetricsTimeseries(registry, window=4, clock=clock)
+    assert ts2.rate("src.count") is None
+    with pytest.raises(ValueError, match="window"):
+        MetricsTimeseries(registry, window=1)
+    summary = ts.summary(keys=["src.level"])
+    assert summary["src.level"]["type"] == "gauge"
+    assert summary["src.level"]["last"] == 19.0
+
+
+def test_prometheus_text_format_types_and_escaping():
+    from skycomputing_tpu.telemetry.exporter import (
+        escape_label_value,
+        prometheus_text,
+        sanitize_metric_name,
+    )
+
+    snap = {
+        "fleet": {
+            "submitted": 42,
+            "pending": 3,
+            "ttft_p95_s": 0.25,
+            "none_field": None,               # not exposable: skipped
+            "rejected_by_reason": {"queue_full": 7,
+                                   'we"ird\nlabel\\': 1},
+        },
+        "__errors__": {"probe": 'died: "so" it\ngoes\\'},
+    }
+    types = {"fleet.submitted": "counter", "fleet.pending": "gauge",
+             "fleet.rejected_by_reason": "counter"}
+    text = prometheus_text(snap, types)
+    assert "# TYPE skytpu_fleet_submitted counter\n" \
+           "skytpu_fleet_submitted 42" in text
+    assert "# TYPE skytpu_fleet_pending gauge" in text
+    # untyped fields emit samples with no TYPE line
+    assert "skytpu_fleet_ttft_p95_s 0.25" in text
+    assert "# TYPE skytpu_fleet_ttft_p95_s" not in text
+    assert "none_field" not in text
+    assert 'skytpu_fleet_rejected_by_reason{key="queue_full"} 7' in text
+    # label escaping: backslash, quote, newline
+    assert 'key="we\\"ird\\nlabel\\\\"' in text
+    # broken sources are visible, not invisible
+    assert "skytpu_metric_source_errors 1" in text
+    assert 'source="probe"' in text
+    # name rules
+    assert sanitize_metric_name("9to5 metric!") == "_9to5_metric_"
+    assert escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+    # strict text round-trip: every sample line parses as name{...} value
+    for line in text.strip().splitlines():
+        assert line.startswith("#") or " " in line
+
+
+def test_exporter_endpoints_and_start_stop_idempotence():
+    import urllib.request
+
+    from skycomputing_tpu.telemetry import (
+        MetricsExporter,
+        MetricsTimeseries,
+    )
+
+    state = {"served": 0}
+    registry = MetricsRegistry()
+    registry.register("web", lambda: {"served": state["served"]},
+                      types={"served": "counter"})
+    clock = FakeClock()
+    ts = MetricsTimeseries(registry, window=16, clock=clock)
+    for _ in range(3):
+        clock.t += 1.0
+        state["served"] += 5
+        ts.sample()
+    exporter = MetricsExporter(
+        registry, timeseries=ts,
+        health=lambda: {"status": "ok", "replicas": {"r0": "healthy"}},
+    )
+    # zero-cost until started: nothing bound, nothing running
+    assert not exporter.running
+    try:
+        started = exporter.start()
+        assert started is exporter and exporter.running
+        port = exporter.port
+        assert port > 0
+        # idempotent start keeps the same server/port
+        assert exporter.start().port == port
+
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5
+            ) as response:
+                return response.read().decode(), response.headers
+
+        body, headers = get("/metrics")
+        assert "text/plain" in headers["Content-Type"]
+        assert "# TYPE skytpu_web_served counter" in body
+        assert "skytpu_web_served 15" in body
+        # the attached timeseries' counter rate rides along
+        assert "skytpu_web_served_per_s 5" in body
+        body, headers = get("/metrics.json")
+        doc = json.loads(body)
+        assert doc["snapshot"]["web"]["served"] == 15
+        assert doc["timeseries"]["samples"] == 3
+        body, _ = get("/healthz")
+        assert json.loads(body)["replicas"] == {"r0": "healthy"}
+        with pytest.raises(urllib.error.HTTPError):
+            get("/nope")
+        assert exporter.requests_served == 3
+    finally:
+        exporter.stop()
+    exporter.stop()  # idempotent
+    assert not exporter.running
+    with pytest.raises(OSError):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=1
+        )
+
+
+def test_slo_monitor_burn_rates_alerts_and_registry_source():
+    from skycomputing_tpu.telemetry import (
+        MetricsTimeseries,
+        SloMonitor,
+        SloTarget,
+    )
+
+    state = {"p95": 0.01, "rejected": 0}
+    registry = MetricsRegistry()
+    registry.register(
+        "fleet", lambda: dict(ttft_p95_s=state["p95"],
+                              rejected=state["rejected"]),
+        types={"rejected": "counter", "ttft_p95_s": "gauge"},
+    )
+    clock = FakeClock()
+    ts = MetricsTimeseries(registry, window=64, clock=clock)
+    monitor = SloMonitor([
+        SloTarget(name="ttft", metric="fleet.ttft_p95_s",
+                  threshold=0.5, budget=0.5, fast_window=1,
+                  slow_window=4),
+        SloTarget(name="rejects", metric="fleet.rejected",
+                  threshold=10.0, kind="rate", fast_window=1,
+                  slow_window=4),
+    ], ts)
+    registry2 = registry  # the monitor registers into any registry
+    registry2.register("slo", monitor.snapshot,
+                       types=SloMonitor.FIELD_TYPES)
+    tracer = Tracer(clock=clock)
+
+    def tick(p95, rejected_step):
+        clock.t += 1.0
+        state["p95"] = p95
+        state["rejected"] += rejected_step
+        ts.sample()
+        return monitor.evaluate(tracer)
+
+    # healthy ticks: nothing fires
+    for _ in range(4):
+        alerts = tick(0.01, 1)
+    assert monitor.firing == ()
+    assert all(not a.firing for a in alerts)
+    # a sustained latency burn: fast window violates immediately, the
+    # slow window needs budget x slow_window = 2 violating samples
+    tick(2.0, 1)
+    assert monitor.firing == ()          # slow window not burned yet
+    alerts = tick(2.0, 1)
+    assert monitor.firing == ("ttft",)
+    ttft = [a for a in alerts if a.target == "ttft"][0]
+    assert ttft.burn_fast >= 1.0 and ttft.burn_slow >= 1.0 and ttft.new
+    # the alert is a trace instant on the slo lane
+    names = [ev[1] for ev in tracer.events()]
+    assert "slo_alert" in names
+    # a rejection STORM fires the rate target (20/s > 10/s budgeted)
+    tick(2.0, 20)
+    tick(2.0, 20)
+    assert "rejects" in monitor.firing
+    # recovery clears, with a visible slo_clear edge
+    for _ in range(6):
+        tick(0.01, 0)
+    assert monitor.firing == ()
+    assert [ev[1] for ev in tracer.events()].count("slo_clear") >= 2
+    assert monitor.fired_ever == {"ttft", "rejects"}
+    # registry-source form: counters survive the clear
+    snap = monitor.snapshot()
+    assert snap["alerts_total"] >= 2 and snap["firing"] == 0
+    assert snap["ttft"]["firing"] == 0
+    # flattened through a timeseries like any other source
+    ts.sample()
+    assert ts.latest("slo.alerts_total") == snap["alerts_total"]
+    with pytest.raises(ValueError, match="duplicate"):
+        SloMonitor([SloTarget(name="x", metric="m", threshold=1.0)] * 2)
+    with pytest.raises(ValueError, match="threshold"):
+        SloTarget(name="r", metric="m", threshold=0.0, kind="rate")
+
+
+def test_request_timeline_from_serving_trace(tmp_path):
+    """A single-engine serving trace reconstructs per request: the
+    queue_wait -> prefill -> decode waterfall with one id, replica
+    attribution, and a terminal finish."""
+    from skycomputing_tpu.builder import build_layer_stack
+    from skycomputing_tpu.models.gpt import GptConfig, gpt_layer_configs
+    from skycomputing_tpu.serving import Request, ServingEngine
+    from skycomputing_tpu.telemetry.analysis import (
+        request_ids,
+        request_timeline,
+    )
+
+    cfg = GptConfig(vocab_size=256, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=2, max_position_embeddings=64,
+                    dropout_prob=0.0, dtype="float32")
+    layer_cfgs = gpt_layer_configs(cfg, deterministic=True)
+    stack = build_layer_stack(layer_cfgs)
+    params = stack.init(jax.random.key(0), np.ones((1, 5), np.int32))
+    tracer = telemetry.enable_tracing()
+    try:
+        engine = ServingEngine(layer_cfgs, list(params), num_slots=2,
+                               max_len=48, buckets=(8, 16),
+                               prefill_batch=1)
+        rng = np.random.default_rng(4)
+        requests = [
+            Request(prompt=rng.integers(1, 256, (n,)).astype(np.int32),
+                    max_new_tokens=4)
+            for n in (5, 9)
+        ]
+        engine.run(requests)
+        events = tracer.to_chrome()["traceEvents"]
+    finally:
+        telemetry.disable_tracing()
+
+    ids = request_ids(events)
+    assert {r.request_id for r in requests} <= set(ids)
+    for r in requests:
+        timeline = request_timeline(events, r.request_id)
+        names = [s["name"] for s in timeline["segments"]]
+        assert names == ["queue_wait", "prefill", "decode"]
+        assert timeline["complete"] and timeline["terminal"] == "finish"
+        assert timeline["orphan_spans"] == 0
+        assert timeline["replicas"] == ["engine"]
+        # segments are contiguous: queue_wait ends where prefill starts
+        segments = timeline["segments"]
+        for a, b in zip(segments, segments[1:]):
+            assert b["start_ms"] >= a["start_ms"]
+        assert timeline["segments"][-1]["args"]["tokens"] == 4
+    # the request lanes were recycled back to the pool at finish
+    assert tracer._req_lanes == {}
+
+
+def test_engine_exporter_and_timeseries_wiring(tmp_path):
+    """ServingEngine: opt-in timeseries sampled per step, exporter
+    serves live counters; both absent (zero-cost) by default."""
+    import urllib.request
+
+    from skycomputing_tpu.builder import build_layer_stack
+    from skycomputing_tpu.models.gpt import GptConfig, gpt_layer_configs
+    from skycomputing_tpu.serving import Request, ServingEngine
+
+    cfg = GptConfig(vocab_size=256, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=2, max_position_embeddings=64,
+                    dropout_prob=0.0, dtype="float32")
+    layer_cfgs = gpt_layer_configs(cfg, deterministic=True)
+    stack = build_layer_stack(layer_cfgs)
+    params = stack.init(jax.random.key(0), np.ones((1, 5), np.int32))
+    engine = ServingEngine(layer_cfgs, list(params), num_slots=2,
+                           max_len=48, buckets=(8,), prefill_batch=1)
+    # disabled path: no series, no server — nothing to pay for
+    assert engine.timeseries is None and engine._exporter is None
+    rng = np.random.default_rng(1)
+    engine.run([Request(prompt=rng.integers(1, 256, (5,)).astype(
+        np.int32), max_new_tokens=3)])
+    assert engine.timeseries is None
+    ts = engine.enable_timeseries(window=64)
+    assert engine.enable_timeseries() is ts  # idempotent
+    exporter = engine.start_exporter()
+    try:
+        engine.run([Request(prompt=rng.integers(1, 256, (6,)).astype(
+            np.int32), max_new_tokens=3)])
+        assert ts.samples >= 2  # one sample per step
+        assert ts.latest("serving.finished") == 2.0
+        with urllib.request.urlopen(
+            f"{exporter.url}/metrics", timeout=5
+        ) as response:
+            body = response.read().decode()
+        assert "# TYPE skytpu_serving_finished counter" in body
+        assert "skytpu_serving_finished 2" in body
+        with urllib.request.urlopen(
+            f"{exporter.url}/healthz", timeout=5
+        ) as response:
+            health = json.loads(response.read().decode())
+        assert health["status"] == "ok" and health["running"] == 0
+    finally:
+        engine.stop_exporter()
+    assert engine._exporter is None
+
+
+def test_request_lane_pool_lease_and_peek():
+    """Under pool exhaustion, mid-request events must PEEK, never
+    lease: a request that started without a lane may not grab a lane
+    freed by a later terminal request and emit retroactive spans over
+    the previous tenant's row."""
+    tracer = Tracer(capacity=64, clock=FakeClock(), request_lanes=1)
+    lane_a = tracer.request_lane("a")
+    assert lane_a is not None
+    assert tracer.request_lane("a") == lane_a        # stable lease
+    assert tracer.request_lane("b") is None          # pool exhausted
+    tracer.release_request_lane("a")
+    # a peek after the free must still find nothing for b...
+    assert tracer.request_lane("b", lease=False) is None
+    # ...only an explicit lease recycles the freed lane
+    assert tracer.request_lane("b") == lane_a
+    assert tracer.request_lane("b", lease=False) == lane_a
+    tracer.release_request_lane("b")
+    tracer.release_request_lane("never-leased")      # no-op
+
+
+def test_exporter_binds_timeseries_regardless_of_call_order():
+    """start_exporter() before enable_timeseries() must still serve
+    the derived rate metrics once the series exists (the exporter
+    follows the host's CURRENT timeseries, not the construction-time
+    one)."""
+    from skycomputing_tpu.telemetry import LiveMetricsMixin
+
+    state = {"n": 0}
+
+    class Host(LiveMetricsMixin):
+        def __init__(self):
+            self.metrics = MetricsRegistry()
+            self.metrics.register("h", lambda: {"n": state["n"]},
+                                  types={"n": "counter"})
+
+        def _health_snapshot(self):
+            return {"status": "ok"}
+
+    host = Host()
+    exporter = host.start_exporter()
+    try:
+        assert "skytpu_h_n_per_s" not in exporter.prometheus_text()
+        clock = FakeClock()
+        ts = host.enable_timeseries(window=8, clock=clock)
+        for _ in range(3):
+            clock.t += 1.0
+            state["n"] += 2
+            ts.sample()
+        text = exporter.prometheus_text()
+        assert "skytpu_h_n_per_s 2" in text  # rates now ride along
+        assert exporter.timeseries is ts
+    finally:
+        host.stop_exporter()
+
+
+def test_timeseries_concurrent_sample_and_read():
+    """Exporter handler threads read while the tick loop samples; the
+    internal lock makes that race-free (no 'changed size during
+    iteration')."""
+    import threading as _threading
+
+    from skycomputing_tpu.telemetry import MetricsTimeseries
+
+    state = {"i": 0}
+    registry = MetricsRegistry()
+    # a source whose KEY SET grows over time maximizes dict churn
+    registry.register(
+        "s", lambda: {f"k{state['i'] % 50}": state["i"],
+                      "total": state["i"]},
+        types={"total": "counter"},
+    )
+    ts = MetricsTimeseries(registry, window=32)
+    errors = []
+
+    def sampler():
+        try:
+            for _ in range(2000):
+                state["i"] += 1
+                ts.sample()
+        except Exception as exc:  # pragma: no cover - failure path
+            errors.append(exc)
+
+    def reader():
+        try:
+            for _ in range(2000):
+                for key in ts.keys():
+                    ts.rate(key)
+                ts.latest_sample()
+                ts.percentile("s.total", 95, window=8)
+        except Exception as exc:  # pragma: no cover - failure path
+            errors.append(exc)
+
+    threads = [_threading.Thread(target=sampler),
+               _threading.Thread(target=reader),
+               _threading.Thread(target=reader)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+
+
+def test_runner_timeseries_samples_each_iteration(devices):
+    """Runner wiring: the opt-in time-series samples the pipeline
+    registry once per training iteration, with the per-step gauge
+    classification."""
+    from skycomputing_tpu.runner import Runner
+
+    model, data, labels, ps = build_pipeline(
+        devices, n_workers=2, units=2
+    )
+    runner = Runner(model, ps, model._worker_manager, max_epochs=1,
+                    max_iters=3)
+    assert runner.timeseries is None  # zero-cost default
+    ts = runner.enable_timeseries(window=16)
+    runner.train(_Loader(data, labels, n=3))
+    assert ts.samples == 3
+    assert ts.latest("pipeline.step_s") > 0
+    assert ts.type_of("pipeline.loss") == "gauge"
+    health = runner._health_snapshot()
+    assert health["iter"] == 3 and health["status"] == "ok"
+
+
+def test_metrics_report_smoke():
+    """The CI lint job's exact invocation: exporter + SLO smoke."""
+    from tools.metrics_report import main as metrics_main
+
+    assert metrics_main(["--smoke"]) == 0
+
+
+def test_trace_report_request_smoke():
+    """The CI lint job's exact invocation: the migrated-request
+    waterfall fixture reconstructs cleanly."""
+    assert report_main(["--smoke", "--request", "7"]) == 0
+    # a bogus id fails loudly, naming the ids that ARE in the trace
+    assert report_main(["--smoke", "--request", "999999"]) == 1
+
+
 def test_logger_levels_and_utc(tmp_path):
     import re
 

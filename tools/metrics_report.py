@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Metrics-plane CLI: Prometheus formatting + SLO burn-rate evaluation.
+
+Two modes:
+
+``--smoke`` (the CI lint-job invocation, pure stdlib — no jax): formats
+one synthetic registry snapshot to Prometheus text exposition format
+and structurally checks it (``# TYPE`` counter/gauge lines, label
+escaping, name sanitization, ``__errors__`` isolation), then evaluates
+two SLO targets against a fake-clock time-series — one burning, one
+healthy — and checks exactly the burning one fires with multi-window
+burn rates.  Structural drift in the exporter or the monitor fails the
+job, so the observability plane cannot silently rot.
+
+``SNAPSHOT.json`` (ad-hoc): render a saved nested registry snapshot
+(the ``/metrics.json`` body, or any ``{source: {field: value}}`` dict)
+as Prometheus text on stdout — handy for eyeballing what a scrape
+would see without starting a server.
+
+Pure stdlib (like ``tools/skylint.py``): when the package import fails
+(no jax on a bare CI runner), the telemetry modules load by file path —
+``timeseries.py``, ``exporter.py`` and ``slo.py`` are pure stdlib by
+contract, so this runs in milliseconds anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+from typing import List, Optional
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_by_path(name: str, *parts: str):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ROOT, *parts)
+    )
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[name] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+try:
+    from skycomputing_tpu.telemetry import exporter as _exporter
+    from skycomputing_tpu.telemetry import metrics as _metrics
+    from skycomputing_tpu.telemetry import slo as _slo
+    from skycomputing_tpu.telemetry import timeseries as _timeseries
+except Exception:  # pragma: no cover - exercised on bare CI runners
+    _tel = ("skycomputing_tpu", "telemetry")
+    _metrics = _load_by_path("skytpu_tel_metrics", *_tel, "metrics.py")
+    _timeseries = _load_by_path(
+        "skytpu_tel_timeseries", *_tel, "timeseries.py")
+    _exporter = _load_by_path("skytpu_tel_exporter", *_tel, "exporter.py")
+    _slo = _load_by_path("skytpu_tel_slo", *_tel, "slo.py")
+
+
+# --------------------------------------------------------------------------
+# smoke
+# --------------------------------------------------------------------------
+
+
+class _FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def run_smoke() -> int:
+    problems: List[str] = []
+
+    # --- a synthetic fleet-shaped registry ---------------------------------
+    state = dict(ttft_p95_s=0.01, rejected=0)
+
+    def fleet_source():
+        return dict(
+            submitted=12, rejected=state["rejected"],
+            rejected_by_reason={"queue_full": state["rejected"]},
+            ttft_p95_s=state["ttft_p95_s"], pending=3,
+            note='quote " backslash \\ newline \n done',
+        )
+
+    def broken_source():
+        raise RuntimeError("injected probe failure")
+
+    registry = _metrics.MetricsRegistry()
+    registry.register("fleet", fleet_source, types={
+        "submitted": "counter", "rejected": "counter",
+        "rejected_by_reason": "counter",
+        "ttft_p95_s": "gauge", "pending": "gauge",
+    })
+    registry.register("probe", broken_source)
+
+    # --- Prometheus text structure -----------------------------------------
+    snap = registry.snapshot()
+    if "probe" in snap or "__errors__" not in snap:
+        problems.append(f"registry did not isolate the raising source: "
+                        f"{sorted(snap)}")
+    text = _exporter.prometheus_text(snap, registry.field_types())
+    for needle in (
+        "# TYPE skytpu_fleet_submitted counter",
+        "skytpu_fleet_submitted 12",
+        "# TYPE skytpu_fleet_pending gauge",
+        'skytpu_fleet_rejected_by_reason{key="queue_full"} 0',
+        "skytpu_metric_source_errors 1",
+        'source="probe"',
+    ):
+        if needle not in text:
+            problems.append(f"prometheus text lost {needle!r}")
+    if 'quote \\" backslash \\\\ newline \\n' not in \
+            _exporter.escape_label_value('quote " backslash \\ newline \n'):
+        problems.append("label escaping broke")
+    if _exporter.sanitize_metric_name("2bad name!") != "_2bad_name_":
+        problems.append(
+            f"name sanitization broke: "
+            f"{_exporter.sanitize_metric_name('2bad name!')!r}"
+        )
+    print("# exporter: TYPE lines, labels, escaping, error isolation ok")
+
+    # --- SLO burn rates over a fake-clock time-series ----------------------
+    clock = _FakeClock()
+    ts = _timeseries.MetricsTimeseries(
+        registry, window=64, clock=clock,
+    )
+    burning = _slo.SloTarget(
+        name="ttft", metric="fleet.ttft_p95_s", threshold=0.5,
+        budget=0.25, fast_window=1, slow_window=8,
+    )
+    healthy = _slo.SloTarget(
+        name="rejections", metric="fleet.rejected", threshold=100.0,
+        kind="rate", fast_window=1, slow_window=8,
+    )
+    monitor = _slo.SloMonitor([burning, healthy], ts)
+    for i in range(8):
+        clock.t += 1.0
+        state["ttft_p95_s"] = 0.01 if i < 4 else 2.0  # spike at i=4
+        state["rejected"] += 1  # 1/s, far under the budgeted 100/s
+        ts.sample()
+        monitor.evaluate()
+    verdicts = {a.target: a for a in monitor.last_alerts()}
+    if not verdicts["ttft"].firing:
+        problems.append(f"burning target did not fire: "
+                        f"{verdicts['ttft'].to_dict()}")
+    elif not (verdicts["ttft"].burn_fast >= 1.0
+              and verdicts["ttft"].burn_slow >= 1.0):
+        problems.append("firing target's burn rates not >= 1.0")
+    if verdicts["rejections"].firing:
+        problems.append(f"healthy rate target fired: "
+                        f"{verdicts['rejections'].to_dict()}")
+    if monitor.alerts_total != 1:
+        problems.append(f"alerts_total {monitor.alerts_total}, "
+                        f"expected 1 rising edge")
+    if monitor.snapshot()["firing"] != 1:
+        problems.append("monitor snapshot does not show the firing "
+                        "target")
+    rate = ts.rate("fleet.rejected")
+    if rate is None or abs(rate - 1.0) > 1e-9:
+        problems.append(f"counter rate {rate}, expected 1.0/s")
+    print(f"# slo: ttft fires (burn fast "
+          f"{verdicts['ttft'].burn_fast:.1f} / slow "
+          f"{verdicts['ttft'].burn_slow:.1f}), rejection rate "
+          f"{rate:.1f}/s stays quiet")
+
+    if problems:
+        for p in problems:
+            print(f"metrics_report --smoke: {p}", file=sys.stderr)
+        return 1
+    print("# smoke: ok")
+    return 0
+
+
+# --------------------------------------------------------------------------
+# snapshot rendering
+# --------------------------------------------------------------------------
+
+
+def render_snapshot(path: str) -> int:
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, json.JSONDecodeError) as exc:
+        print(f"metrics_report: cannot read {path}: {exc}",
+              file=sys.stderr)
+        return 1
+    if isinstance(data, dict) and isinstance(data.get("snapshot"), dict):
+        data = data["snapshot"]  # a saved /metrics.json body
+    if not isinstance(data, dict):
+        print(f"metrics_report: {path} is not a snapshot object",
+              file=sys.stderr)
+        return 1
+    sys.stdout.write(_exporter.prometheus_text(data))
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("snapshot", nargs="?",
+                        help="nested registry snapshot JSON to render "
+                             "as Prometheus text")
+    parser.add_argument("--smoke", action="store_true",
+                        help="exporter + SLO structural check "
+                             "(pure stdlib, the CI invocation)")
+    args = parser.parse_args(argv)
+    if args.smoke:
+        return run_smoke()
+    if not args.snapshot:
+        parser.error("a snapshot file (or --smoke) is required")
+    return render_snapshot(args.snapshot)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

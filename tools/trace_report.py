@@ -65,6 +65,8 @@ baseline_targets = _analysis.baseline_targets
 check_regression = _analysis.check_regression
 load_events = _analysis.load_events
 measured_stage_seconds = _analysis.measured_stage_seconds
+request_ids = _analysis.request_ids
+request_timeline = _analysis.request_timeline
 serving_padding_fraction = _analysis.serving_padding_fraction
 
 
@@ -76,6 +78,43 @@ serving_padding_fraction = _analysis.serving_padding_fraction
 def _smoke_fixture() -> str:
     return os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "fixtures", "trace_smoke.json")
+
+
+def _request_fixture() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures", "trace_request.json")
+
+
+def _print_request(timeline: Dict[str, Any]) -> None:
+    """Human waterfall: segments and markers in one time-ordered list,
+    with per-segment replica attribution."""
+    t0 = timeline["start_ms"]
+    print(f"# request {timeline['request']}: "
+          f"{len(timeline['segments'])} segments over "
+          f"{len(timeline['replicas'])} replica(s) "
+          f"({', '.join(timeline['replicas']) or 'unattributed'}), "
+          f"{timeline['migrations']} migration(s), "
+          f"{'complete' if timeline['complete'] else 'INCOMPLETE'}"
+          + (f" ({timeline['terminal']})" if timeline["terminal"]
+             else ""))
+    rows = (
+        [("span", s["start_ms"], s) for s in timeline["segments"]]
+        + [("mark", m["ts_ms"], m) for m in timeline["markers"]]
+    )
+    for kind, ts, item in sorted(rows, key=lambda r: r[1]):
+        where = item.get("replica")
+        extra = {k: v for k, v in item["args"].items()
+                 if k not in ("replica", "from")}
+        suffix = f"  {extra}" if extra else ""
+        if kind == "span":
+            print(f"#   [{item['start_ms'] - t0:10.3f} -> "
+                  f"{item['end_ms'] - t0:10.3f} ms] "
+                  f"{item['name']:<12} @ {where or '-'}{suffix}")
+        else:
+            print(f"#    {ts - t0:10.3f} ms {'':>15} "
+                  f"{item['name']:<12} @ {where or '-'}{suffix}")
+    print(f"# max inter-segment gap {timeline['max_gap_ms']:.3f} ms, "
+          f"orphan spans {timeline['orphan_spans']}")
 
 
 def _print_human(report: Dict[str, Any]) -> None:
@@ -110,6 +149,64 @@ def _print_human(report: Dict[str, Any]) -> None:
           f"{report['transfers']['elided']} elided")
 
 
+def _run_request_mode(path: str, args) -> int:
+    """``--request ID``: the per-request waterfall path (no aggregate
+    analysis — a request-only trace has no stage lanes to analyze)."""
+    try:
+        events = load_events(path)
+        timeline = request_timeline(events, args.request)
+    except (OSError, json.JSONDecodeError, TraceError, KeyError) as exc:
+        known = []
+        try:
+            known = request_ids(load_events(path))
+        except Exception:
+            pass
+        print(f"trace_report: cannot reconstruct request "
+              f"{args.request} from {path}: {exc}"
+              + (f" (ids in trace: {known[:20]})" if known else ""),
+              file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(timeline), flush=True)
+    else:
+        _print_request(timeline)
+    if args.smoke:
+        # structural self-check: the fixture encodes one request
+        # migrated across two replicas with a complete waterfall
+        problems = []
+        if not timeline["complete"]:
+            problems.append("fixture request never reached a terminal "
+                            "marker")
+        if timeline["migrations"] < 1:
+            problems.append("fixture lost its migration marker")
+        if len(timeline["replicas"]) < 2:
+            problems.append(
+                f"fixture spans {timeline['replicas']}, expected two "
+                f"replicas"
+            )
+        if len(timeline["segments"]) < 5:
+            problems.append(
+                f"fixture has {len(timeline['segments'])} segments, "
+                f"expected the full queue/prefill/decode x2 waterfall"
+            )
+        if timeline["orphan_spans"]:
+            problems.append(
+                f"{timeline['orphan_spans']} orphan span(s) after the "
+                f"terminal marker"
+            )
+        names = {s["name"] for s in timeline["segments"]}
+        if not {"queue_wait", "prefill", "decode"} <= names:
+            problems.append(f"fixture segment names {sorted(names)} "
+                            f"lost a waterfall phase")
+        if problems:
+            for p in problems:
+                print(f"trace_report --smoke --request: {p}",
+                      file=sys.stderr)
+            return 1
+        print("# smoke: ok")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("trace", nargs="?",
@@ -124,14 +221,25 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "rides along under 'baseline_gate')")
     parser.add_argument("--smoke", action="store_true",
                         help="analyze the checked-in fixture trace and "
-                             "verify the report's structure")
+                             "verify the report's structure (with "
+                             "--request: the request-waterfall fixture)")
+    parser.add_argument("--request", type=int, default=None,
+                        metavar="ID",
+                        help="reconstruct one request's end-to-end "
+                             "waterfall (queue/admission/prefill/"
+                             "decode/migration segments) instead of "
+                             "the aggregate report")
     args = parser.parse_args(argv)
 
     path = args.trace
     if args.smoke:
-        path = path or _smoke_fixture()
+        path = path or (_request_fixture() if args.request is not None
+                        else _smoke_fixture())
     if not path:
         parser.error("a trace file (or --smoke) is required")
+
+    if args.request is not None:
+        return _run_request_mode(path, args)
 
     try:
         report = analyze(load_events(path))
