@@ -37,7 +37,12 @@ from .dynamics import (
 from .fleet import FleetSupervisor, Router, ServingFleet
 from .parallel import PipelineModel, StageRuntime
 from .runner import AutotuneHook, Hook, Runner
-from .serving import Request, ServingEngine
+from .serving import (
+    PagedKVCachePool,
+    RadixPrefixIndex,
+    Request,
+    ServingEngine,
+)
 from .tuning import ServingAutotuner, TuningAdvisor
 from .stimulator import Stimulator
 from .telemetry import (
@@ -84,6 +89,8 @@ __all__ = [
     "Hook",
     "Runner",
     "AutotuneHook",
+    "PagedKVCachePool",
+    "RadixPrefixIndex",
     "Request",
     "ServingEngine",
     "ServingFleet",

@@ -269,22 +269,41 @@ def _serving_kv_profile(
     """Per-layer KV-slab MB for a serving context, or None if the
     context is unusable (a diagnostic is appended).
 
-    ``serving``: ``slots`` (required), ``max_len`` (required),
-    ``bucket`` (optional, reported in diagnostics), ``kv_mb_per_layer``
-    (optional explicit profile — must match the model length; computed
-    from the config via the engine's own slab formula otherwise).
+    ``serving``: either the SLOT operating point — ``slots`` +
+    ``max_len`` (both required) — or the PAGED one — ``num_pages`` +
+    ``page_size`` (both required; the pool is ``num_pages x page_size``
+    positions, byte-identical formula) with optional
+    ``max_pages_per_request``; plus ``bucket`` (optional, reported in
+    diagnostics) and ``kv_mb_per_layer`` (optional explicit profile —
+    must match the model length; computed from the config via the
+    engine's own slab formula otherwise).
     """
     severity = "error" if memory == "error" else "warning"
-    try:
-        slots = int(serving["slots"])
-        max_len = int(serving["max_len"])
-    except (KeyError, TypeError, ValueError):
-        issues.append(PlanIssue(
-            "memory", severity,
-            f"serving context must carry integer 'slots' and 'max_len' "
-            f"(got {serving!r}) — cannot account for KV-slab memory"
-        ))
-        return None
+    paged = "num_pages" in serving or "page_size" in serving
+    if paged:
+        try:
+            slots = int(serving["num_pages"])
+            max_len = int(serving["page_size"])
+        except (KeyError, TypeError, ValueError):
+            issues.append(PlanIssue(
+                "memory", severity,
+                f"paged serving context must carry integer 'num_pages' "
+                f"and 'page_size' (got {serving!r}) — cannot account "
+                f"for page-pool memory"
+            ))
+            return None
+    else:
+        try:
+            slots = int(serving["slots"])
+            max_len = int(serving["max_len"])
+        except (KeyError, TypeError, ValueError):
+            issues.append(PlanIssue(
+                "memory", severity,
+                f"serving context must carry integer 'slots' and "
+                f"'max_len' (got {serving!r}) — cannot account for "
+                f"KV-slab memory"
+            ))
+            return None
     explicit = serving.get("kv_mb_per_layer")
     if explicit is not None:
         # a validator that crashes on malformed input defeats itself:
@@ -325,6 +344,18 @@ def _serving_label(serving: Dict) -> str:
         tail = f", bucket {int(bucket)}" if bucket is not None else ""
     except (TypeError, ValueError):
         tail = f", bucket {bucket!r}"
+    if "num_pages" in serving or "page_size" in serving:
+        mpr = serving.get("max_pages_per_request")
+        try:
+            span = (
+                f", {int(mpr)} pages/request" if mpr is not None else ""
+            )
+        except (TypeError, ValueError):
+            span = f", {mpr!r} pages/request"
+        return (
+            f"{int(serving['num_pages'])} KV pages x page_size "
+            f"{int(serving['page_size'])}{span}{tail}"
+        )
     return (
         f"{int(serving['slots'])} KV slots x max_len "
         f"{int(serving['max_len'])}{tail}"
@@ -782,24 +813,54 @@ def _pos_int(v) -> bool:
 def _verify_serving_payload(serving: Any) -> List[str]:
     """Problems with a payload's optional ``serving`` operating point.
 
-    Schema: ``slots`` / ``max_len`` positive ints (required — the
-    relaunched engine preallocates its slabs from them), optional
-    ``buckets`` a strictly increasing list of positive ints none of
-    which exceeds ``max_len`` (a bucket past the slab depth would admit
-    prompts the cache cannot hold).
+    Schema, slot layout: ``slots`` / ``max_len`` positive ints
+    (required — the relaunched engine preallocates its slabs from
+    them).  Paged layout (any of ``num_pages`` / ``page_size`` /
+    ``max_pages_per_request`` present): all three positive ints, and
+    the per-request span is ``max_pages_per_request x page_size``.
+    Either way, optional ``buckets`` is a strictly increasing list of
+    positive ints none of which exceeds the per-request span (a bucket
+    past the cache depth would admit prompts the cache cannot hold).
     """
     if not isinstance(serving, dict):
         return [
             f"'serving' must be an object, got {type(serving).__name__}"
         ]
     problems: List[str] = []
-    for key in ("slots", "max_len"):
-        v = serving.get(key)
-        if not _pos_int(v):
+    paged = any(
+        k in serving
+        for k in ("num_pages", "page_size", "max_pages_per_request")
+    )
+    if paged:
+        for key in ("num_pages", "page_size", "max_pages_per_request"):
+            v = serving.get(key)
+            if not _pos_int(v):
+                problems.append(
+                    f"serving.{key} must be a positive int (paged KV "
+                    f"pool shape), got {v!r}"
+                )
+        np_, ps, mpr = (
+            serving.get("num_pages"), serving.get("page_size"),
+            serving.get("max_pages_per_request"),
+        )
+        if _pos_int(np_) and _pos_int(mpr) and mpr > np_:
             problems.append(
-                f"serving.{key} must be a positive int (KV slot pool "
-                f"shape), got {v!r}"
+                f"serving.max_pages_per_request {mpr} exceeds "
+                f"serving.num_pages {np_} — one request could never "
+                f"be charged"
             )
+        # buckets bound against the per-request virtual span below
+        serving = dict(serving)
+        if _pos_int(ps) and _pos_int(mpr):
+            serving.setdefault("max_len", ps * mpr)
+    else:
+        for key in ("slots", "max_len"):
+            v = serving.get(key)
+            if not _pos_int(v):
+                problems.append(
+                    f"serving.{key} must be a positive int (KV slot "
+                    f"pool shape), got {v!r}"
+                )
     buckets = serving.get("buckets")
     if buckets is not None:
         if not isinstance(buckets, list) or not buckets:
@@ -840,6 +901,9 @@ def verify_tuning_knobs(
     max_len: Optional[int] = None,
     num_slots: Optional[int] = None,
     prefill_batch: Optional[int] = None,
+    num_pages: Optional[int] = None,
+    page_size: Optional[int] = None,
+    max_pages_per_request: Optional[int] = None,
 ) -> PlanReport:
     """Pre-flight a *knob-level* operating-point change (no eval_shape).
 
@@ -874,6 +938,19 @@ def verify_tuning_knobs(
         err(f"num_slots must be a positive int, got {num_slots!r}")
     if prefill_batch is not None and not _pos_int(prefill_batch):
         err(f"prefill_batch must be a positive int, got {prefill_batch!r}")
+    for name, v in (("num_pages", num_pages), ("page_size", page_size),
+                    ("max_pages_per_request", max_pages_per_request)):
+        if v is not None and not _pos_int(v):
+            err(f"{name} must be a positive int, got {v!r}")
+    if (_pos_int(num_pages) and _pos_int(max_pages_per_request)
+            and max_pages_per_request > num_pages):
+        err(f"max_pages_per_request {max_pages_per_request} exceeds "
+            f"num_pages {num_pages} — one request could never be "
+            f"charged")
+    if (_pos_int(page_size) and _pos_int(max_pages_per_request)
+            and max_len is None):
+        # the paged per-request span IS the bucket bound
+        max_len = page_size * max_pages_per_request
     if buckets is not None:
         # synthesize a max_len fallback from the WELL-FORMED buckets
         # only: a malformed entry must surface as a PlanIssue below,

@@ -30,7 +30,13 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 #: prompt tokens hashed into the affinity key: long enough to separate
 #: real system prompts, short enough that near-identical prompts collide
-#: into the same warm replica
+#: into the same warm replica.  When the fleet serves PAGED engines,
+#: construct the router with ``page_size=<engine page_size>`` instead:
+#: the key then becomes the first full KV page of the prompt — the
+#: minimal unit the radix prefix cache can share — so affinity routing
+#: sends same-system-prompt requests to the replica that already holds
+#: their prefix pages, and the hint pays off as REAL ``prefix_hits``
+#: instead of just warm compiled buckets.
 DEFAULT_PREFIX_TOKENS = 8
 
 
@@ -38,6 +44,14 @@ def prefix_key(prompt: Sequence[int],
                n: int = DEFAULT_PREFIX_TOKENS) -> Tuple[int, ...]:
     """The affinity key for a prompt: its first ``n`` token ids."""
     return tuple(int(t) for t in list(prompt)[:n])
+
+
+def radix_prefix_key(prompt: Sequence[int],
+                     page_size: int) -> Tuple[int, ...]:
+    """The paged affinity key: the prompt's first full KV page (or the
+    whole prompt when it is shorter than one page — too short to share
+    pages, but still a stable identity for bucket warmth)."""
+    return tuple(int(t) for t in list(prompt)[:max(int(page_size), 1)])
 
 
 def replica_load(snapshot: Dict[str, Any],
@@ -86,17 +100,33 @@ class Router:
 
     def __init__(self, affinity_slack: float = 2.0,
                  prefix_tokens: int = DEFAULT_PREFIX_TOKENS,
-                 max_affinity: int = 4096):
+                 max_affinity: int = 4096,
+                 page_size: Optional[int] = None):
         if affinity_slack < 0:
             raise ValueError(
                 f"affinity_slack must be >= 0, got {affinity_slack}"
             )
         self.affinity_slack = float(affinity_slack)
-        self.prefix_tokens = int(prefix_tokens)
+        # page_size aligns the affinity key with the radix prefix
+        # cache's sharing unit (one full page): requests that CAN share
+        # pages get the same key, so sticking them to one replica turns
+        # the locality hint into real prefix_hits there
+        self.page_size = None if page_size is None else int(page_size)
+        self.prefix_tokens = (
+            int(prefix_tokens) if self.page_size is None
+            else self.page_size
+        )
         self.max_affinity = int(max_affinity)
         # prefix key -> replica name; plain dict, insertion-ordered, so
         # the cap evicts the oldest learned affinity first
         self._affinity: Dict[Tuple[int, ...], str] = {}
+
+    def _key(self, prompt: Sequence[int]) -> Tuple[int, ...]:
+        """The affinity key: radix-aligned (first full KV page) on
+        paged fleets, first-``prefix_tokens`` otherwise."""
+        if self.page_size is not None:
+            return radix_prefix_key(prompt, self.page_size)
+        return prefix_key(prompt, self.prefix_tokens)
 
     # --- ranking -----------------------------------------------------------
     def rank(self, snapshots: Sequence[Dict[str, Any]],
@@ -119,7 +149,7 @@ class Router:
         )
         names = [str(s["name"]) for s in ordered]
         if prompt is not None:
-            key = prefix_key(prompt, self.prefix_tokens)
+            key = self._key(prompt)
             sticky = self._affinity.get(key)
             if sticky is not None and sticky in names:
                 by_name = {str(s["name"]): s for s in healthy}
@@ -148,7 +178,7 @@ class Router:
         """Learn (or refresh) the prefix -> replica affinity after an
         actual dispatch — the router only trusts placements that
         happened, not ones it merely suggested."""
-        key = prefix_key(prompt, self.prefix_tokens)
+        key = self._key(prompt)
         # re-insert so the cap below evicts least-recently-dispatched
         self._affinity.pop(key, None)
         self._affinity[key] = str(replica_name)
@@ -173,5 +203,6 @@ __all__ = [
     "DEFAULT_PREFIX_TOKENS",
     "Router",
     "prefix_key",
+    "radix_prefix_key",
     "replica_load",
 ]

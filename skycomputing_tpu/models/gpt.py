@@ -234,6 +234,53 @@ class GptBlock_Attn(nn.Module):
         ctx = ctx.reshape(ctx.shape[0], ctx.shape[1], cfg.hidden_size)
         return hidden + self.c_proj(ctx), k_cache, v_cache
 
+    def decode_paged(
+        self, hidden, k_slab, v_slab, page_table, index, valid_len
+    ):
+        """One incremental step against PAGED slabs (PagedAttention).
+
+        ``hidden``: [R, Lq, H] new positions index..index+Lq-1 per row;
+        ``k_slab``/``v_slab``: [num_pages, page_size, heads, head_dim]
+        physical page pools shared by every row; ``page_table``:
+        [R, max_pages] logical->physical map (sentinel-padded);
+        ``index``/``valid_len``: [R] per-row start and true end
+        positions (pad-tail writes drop; see
+        ``serving/kv_cache.paged_update_kv``).  Attention runs over the
+        gathered virtual view — logical position v of row r reads
+        page ``v // page_size`` at offset ``v % page_size`` — with the
+        same causal/staleness mask as the slot path, so the two layouts
+        share one visibility definition.  Returns
+        (new_hidden, k_slab, v_slab).
+        """
+        from ..serving.kv_cache import (
+            decode_visibility,
+            gather_kv_pages,
+            paged_update_kv,
+        )
+
+        cfg = _gcfg(self.config)
+        dtype = jnp.dtype(cfg.dtype)
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        q, k_new, v_new = self._qkv(hidden)
+
+        k_slab, v_slab = paged_update_kv(
+            k_slab, v_slab, k_new, v_new, page_table, index, valid_len
+        )
+        k_virt, v_virt = gather_kv_pages(k_slab, v_slab, page_table)
+
+        scores = jnp.einsum(
+            "blhd,bmhd->bhlm", q, k_virt.astype(dtype)
+        ) / jnp.sqrt(jnp.asarray(head_dim, dtype))
+        Lq, virt_len = q.shape[1], k_virt.shape[1]
+        visible = decode_visibility(index, Lq, virt_len)
+        scores = jnp.where(visible[:, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
+            dtype
+        )
+        ctx = jnp.einsum("bhlm,bmhd->blhd", probs, v_virt.astype(dtype))
+        ctx = ctx.reshape(ctx.shape[0], ctx.shape[1], cfg.hidden_size)
+        return hidden + self.c_proj(ctx), k_slab, v_slab
+
 
 @LAYER.register_module
 class GptBlock_Mlp(nn.Module):
@@ -522,6 +569,52 @@ def apply_kv_cached(modules, params_list, data, caches, index):
     return data, new_caches
 
 
+def apply_kv_paged(
+    modules, params_list, data, slabs, page_table, index, valid_len
+):
+    """Thread one PAGED decode step through a module slice — the paged
+    twin of :func:`apply_kv_cached`.
+
+    ``slabs`` is one ``[num_pages, page_size, heads, head_dim]`` (k, v)
+    pair per attention unit in the slice; ``page_table``/``index``/
+    ``valid_len`` are shared across the slice's layers (one logical
+    sequence per row, every layer caches it at the same positions).
+    Both prefill (``Lq = bucket``, ``index`` = per-row shared-prefix
+    offsets) and decode (``Lq = 1``) are this one function at different
+    input shapes, so the steady state compiles exactly one decode
+    program and one prefill program per bucket — the slot layout's
+    recompile discipline, kept.
+    """
+    if len(params_list) != len(modules):
+        raise ValueError(
+            f"got {len(params_list)} param trees for "
+            f"{len(modules)} layers"
+        )
+    new_slabs = list(slabs)
+    n_attn = len(attn_indices(modules))
+    if len(new_slabs) != n_attn:
+        raise ValueError(
+            f"got {len(new_slabs)} cache pairs for {n_attn} "
+            f"attention units"
+        )
+    cache_i = 0
+    for module, params in zip(modules, params_list):
+        if isinstance(module, GptEmbeddings):
+            data = module.apply({"params": params}, data, index,
+                                method=GptEmbeddings.decode)
+        elif isinstance(module, GptBlock_Attn):
+            k, v = new_slabs[cache_i]
+            data, k, v = module.apply(
+                {"params": params}, data, k, v, page_table, index,
+                valid_len, method=GptBlock_Attn.decode_paged,
+            )
+            new_slabs[cache_i] = (k, v)
+            cache_i += 1
+        else:
+            data = module.apply({"params": params}, data)
+    return data, new_slabs
+
+
 class CachedGptDecoder:
     """KV-cache incremental decoding over the decomposed GPT layer stack.
 
@@ -678,6 +771,7 @@ __all__ = [
     "generate_cached",
     "CachedGptDecoder",
     "apply_kv_cached",
+    "apply_kv_paged",
     "attn_indices",
     "decode_modules",
 ]

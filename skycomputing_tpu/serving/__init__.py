@@ -4,10 +4,15 @@ The training side of this repo reproduces the paper's contribution —
 profile-driven layer->device allocation for heterogeneous pipelines;
 this package is the serving side the ROADMAP's north star demands:
 
-- :mod:`.kv_cache` — the single slot-based KV-cache implementation
-  (fixed ``[slots, max_len, heads, head_dim]`` slabs, free-slot
-  allocator, donation-friendly in-place updates) that also backs
-  ``models/gpt.py``'s single-request decoder;
+- :mod:`.kv_cache` — the KV-cache device math for both layouts: slot
+  slabs (fixed ``[slots, max_len, heads, head_dim]``, also backing
+  ``models/gpt.py``'s single-request decoder) and paged pools
+  (``[num_pages, page_size, heads, head_dim]`` gather/scatter through
+  page tables), donation-friendly in-place updates throughout;
+- :mod:`.paging` — the paged host bookkeeping (pure stdlib):
+  free-list page allocator with refcounts and copy-on-write grants,
+  radix prefix index for compute-once shared prompts, decode-row
+  ledger, swap-vs-recompute preemption policy;
 - :mod:`.batcher` — shape-bucketing admission (prompt lengths padded to
   a small fixed bucket set so steady-state decode compiles once);
 - :mod:`.engine` — :class:`ServingEngine`, iteration-level continuous
@@ -35,10 +40,21 @@ from .engine import ServingEngine, ServingStats
 from .kv_cache import (
     KVCacheSpec,
     SlotKVCachePool,
+    gather_kv_pages,
     init_layer_caches,
+    init_paged_caches,
     kv_mb_per_layer,
     kv_spec_from_config,
+    paged_kv_mb_per_layer,
+    paged_update_kv,
     update_kv_cache,
+)
+from .paging import (
+    PagedKVCachePool,
+    RadixPrefixIndex,
+    RowAllocator,
+    choose_preempt_mode,
+    pages_for,
 )
 from .profile import DecodeModelBenchmarker
 
@@ -46,14 +62,23 @@ __all__ = [
     "AdmissionQueue",
     "DecodeModelBenchmarker",
     "KVCacheSpec",
+    "PagedKVCachePool",
     "QueueFullError",
+    "RadixPrefixIndex",
     "Request",
+    "RowAllocator",
     "ServingEngine",
     "ServingStats",
     "ShapeBucketer",
     "SlotKVCachePool",
+    "choose_preempt_mode",
+    "gather_kv_pages",
     "init_layer_caches",
+    "init_paged_caches",
     "kv_mb_per_layer",
     "kv_spec_from_config",
+    "paged_kv_mb_per_layer",
+    "paged_update_kv",
+    "pages_for",
     "update_kv_cache",
 ]

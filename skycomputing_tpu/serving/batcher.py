@@ -220,12 +220,18 @@ class AdmissionQueue:
     def depth(self) -> int:
         return len(self._queue)
 
-    def submit(self, request: Request, force: bool = False) -> None:
+    def submit(self, request: Request, force: bool = False,
+               require_bucket: bool = True) -> None:
         # bucket validation FIRST (its ValueError is the older contract
         # and callers match on it), capacity second, state mutation last
-        # — a rejected request keeps its pre-submit state
-        bucket = self.bucketer.bucket_for(
-            int(request.effective_prompt.size)
+        # — a rejected request keeps its pre-submit state.
+        # ``require_bucket=False`` is the paged engine's swap re-queue:
+        # a swapped request resumes from host page copies with NO
+        # prefill, so it needs no bucket — exactly how swap serves
+        # resume prefixes that have outgrown every bucket.
+        bucket = (
+            self.bucketer.bucket_for(int(request.effective_prompt.size))
+            if require_bucket else None
         )
         if (not force and self.max_queue is not None
                 and len(self._queue) >= self.max_queue):
@@ -239,6 +245,20 @@ class AdmissionQueue:
         request.status = QUEUED
         request.bucket = bucket
         self._queue.append(request)
+
+    def remove(self, request: Request) -> None:
+        """Remove a specific queued request (the paged engine's wave
+        selection dequeues its own members — tail buckets are computed
+        against the live prefix cache, not the submit-time prompt).
+        Identity-based: ``Request`` is a dataclass over numpy arrays,
+        so ``==`` would compare prompt contents elementwise."""
+        for i, r in enumerate(self._queue):
+            if r is request:
+                del self._queue[i]
+                return
+        raise ValueError(
+            f"request {request.request_id} is not queued"
+        )
 
     def shed_oldest(self) -> Optional[Request]:
         """Remove and return the oldest SHEDDABLE queued request (the

@@ -14,10 +14,19 @@ implemented here:
   occupies one between ticks; the running batch never drains to
   accommodate either — the static-batching failure mode where every
   member waits for the slowest.
-- **slot-based KV caching** (the fixed-slab half of PagedAttention,
-  SOSP '23): per-stage preallocated ``[slots, max_len, heads,
-  head_dim]`` slabs (``serving/kv_cache.py``) give every compiled
-  program a fixed shape regardless of which requests are live.  Decode
+- **fixed-shape KV caching** in two layouts.  ``kv_layout="slot"``
+  (the compatibility default): per-stage preallocated ``[slots,
+  max_len, heads, head_dim]`` slabs (``serving/kv_cache.py``) — one
+  whole row per request.  ``kv_layout="paged"`` (PagedAttention,
+  SOSP '23 + SGLang-style radix prefix caching): per-stage
+  ``[num_pages, page_size, heads, head_dim]`` page pools addressed
+  through per-request page tables (host bookkeeping — free-list
+  allocator, refcounts, copy-on-write prefix sharing, radix index,
+  swap-preemption — in ``serving/paging.py``), so admission charges a
+  request its TRUE footprint in pages and concurrency floats with
+  memory instead of a slot count (>2x sustained at equal pool MB,
+  gated in ``BENCH_serving.json``).  Either way every compiled program
+  keeps a fixed shape regardless of which requests are live: decode
   compiles ONCE; prefill compiles once per prompt-length bucket
   (``serving/batcher.py``); after warmup the steady state is
   zero-recompile, pinned by ``xla_compile_count()`` in
@@ -51,6 +60,7 @@ from ..models.gpt import (
     GptEmbeddings,
     _gcfg,
     apply_kv_cached,
+    apply_kv_paged,
     attn_indices,
     decode_modules,
 )
@@ -69,7 +79,17 @@ from .batcher import (
     Request,
     ShapeBucketer,
 )
-from .kv_cache import SlotKVCachePool, kv_spec_from_config
+from .kv_cache import (
+    SlotKVCachePool,
+    init_paged_caches,
+    kv_spec_from_config,
+)
+from .paging import (
+    PagedKVCachePool,
+    RowAllocator,
+    choose_preempt_mode,
+    pages_for,
+)
 
 
 # one compiled gather/argmax pair per (batch, vocab) shape — module-level
@@ -126,9 +146,22 @@ class ServingStats:
     # shedding is only acceptable when it is visible
     queue_rejections: int = 0
     compiles: int = 0
+    # paged-KV accounting (kv_layout="paged"; zero on slot engines):
+    # prefix_hits/prefix_tokens_reused measure the radix cache
+    # (prefill compute NOT spent), cow_copies the partial-page clones
+    # that keep shared pages read-only, swap_outs/swap_ins the
+    # host-pool preemption path, prefix_evictions the LRU pressure
+    prefix_hits: int = 0
+    prefix_tokens_reused: int = 0
+    cow_copies: int = 0
+    swap_outs: int = 0
+    swap_ins: int = 0
+    prefix_evictions: int = 0
     # gauges
     queue_depth: int = 0
     batch_occupancy: float = 0.0
+    pages_in_use: int = 0
+    free_pages: int = 0
     # blocked wall time per phase (timed across block_until_ready)
     prefill_s: float = 0.0
     decode_s: float = 0.0
@@ -151,7 +184,11 @@ class ServingStats:
         "queue_stalls": "counter", "queue_rejections": "counter",
         "compiles": "counter", "prefill_s": "counter",
         "decode_s": "counter",
+        "prefix_hits": "counter", "prefix_tokens_reused": "counter",
+        "cow_copies": "counter", "swap_outs": "counter",
+        "swap_ins": "counter", "prefix_evictions": "counter",
         "queue_depth": "gauge", "batch_occupancy": "gauge",
+        "pages_in_use": "gauge", "free_pages": "gauge",
         "tokens_per_s": "gauge",
         "ttft_p50_s": "gauge", "ttft_p95_s": "gauge",
         "tpot_p50_s": "gauge", "tpot_p95_s": "gauge",
@@ -185,8 +222,16 @@ class ServingStats:
             queue_stalls=self.queue_stalls,
             queue_rejections=self.queue_rejections,
             compiles=self.compiles,
+            prefix_hits=self.prefix_hits,
+            prefix_tokens_reused=self.prefix_tokens_reused,
+            cow_copies=self.cow_copies,
+            swap_outs=self.swap_outs,
+            swap_ins=self.swap_ins,
+            prefix_evictions=self.prefix_evictions,
             queue_depth=self.queue_depth,
             batch_occupancy=self.batch_occupancy,
+            pages_in_use=self.pages_in_use,
+            free_pages=self.free_pages,
             prefill_s=self.prefill_s,
             decode_s=self.decode_s,
             tokens_per_s=self.tokens_per_s(),
@@ -298,6 +343,117 @@ class _ServingStage:
         return SlotKVCachePool(self.specs, num_slots, device=self.device)
 
 
+# small paged-slab utilities, module-level jits so every engine shares
+# the executables (shape-keyed: one compile per slab geometry).
+# _copy_page is undonated, so on accelerators each COW event pays a
+# slab-sized copy; COW fires at most once per prefix-hit admission, so
+# this is off the per-token path — donate + rebind if it ever shows up
+_copy_page = jax.jit(lambda slab, src, dst: slab.at[dst].set(slab[src]))
+_gather_rows = jax.jit(
+    lambda slab, table: slab[jnp.clip(table, 0, slab.shape[0] - 1)]
+)
+_scatter_rows = jax.jit(
+    lambda slab, table, vals: slab.at[table].set(
+        vals.astype(slab.dtype), mode="drop"
+    )
+)
+
+
+class _PagedServingStage:
+    """One pipeline stage under the PAGED layout: module slice + device
+    + per-attention-layer page slabs ``[num_pages, page_size, heads,
+    head_dim]`` + the one fused step program (prefill and decode are
+    the same function at different input shapes — see
+    ``models/gpt.apply_kv_paged``)."""
+
+    def __init__(
+        self,
+        stage_index: int,
+        modules: Sequence[Any],
+        params: Sequence[Any],
+        device,
+        num_pages: int,
+        page_size: int,
+        program_key: Optional[str] = None,
+    ):
+        self.stage_index = stage_index
+        self.modules = list(modules)
+        self.device = device
+        self.lane_name = f"stage {stage_index} [{device}]"
+        self.params: List[Any] = jax.device_put(list(params), device)
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.specs = [
+            kv_spec_from_config(
+                _gcfg(self.modules[i].config).to_dict(), page_size
+            )
+            for i in attn_indices(self.modules)
+        ]
+        self.slabs = self.build_slabs(num_pages, page_size)
+        cached = (
+            _STAGE_PROGRAMS.get(program_key)
+            if program_key is not None else None
+        )
+        if cached is not None:
+            self._step_donated = cached
+            return
+        mods = self.modules
+
+        def step(params_list, data, slabs, tables, index, valid_len):
+            return apply_kv_paged(
+                mods, params_list, data, slabs, tables, index, valid_len
+            )
+
+        if _donation_enabled():
+            self._step_donated = jax.jit(step, donate_argnums=(2,))
+        else:
+            self._step_donated = jax.jit(step)
+        if program_key is not None:
+            _STAGE_PROGRAMS[program_key] = self._step_donated
+
+    def build_slabs(self, num_pages: int, page_size: int):
+        """Fresh zeroed page slabs (construction + the reconfigure
+        pre-build, so an allocation failure surfaces while the engine
+        is still intact)."""
+        return init_paged_caches(
+            self.specs, num_pages, page_size, device=self.device
+        )
+
+    def cow_copy(self, src: int, dst: int) -> None:
+        """Clone physical page ``src`` into ``dst`` across every layer
+        (the grant's copy-on-write step: the donor's partial page
+        becomes the sharer's private page before any append)."""
+        s = np.int32(src)
+        d = np.int32(dst)
+        self.slabs = [
+            (_copy_page(k, s, d), _copy_page(v, s, d))
+            for k, v in self.slabs
+        ]
+
+    def swap_out(self, table: np.ndarray) -> List[Any]:
+        """Host copies of the pages in ``table`` (sentinel-padded, so
+        the gathered shape is fixed at [max_pages, page_size, ...] and
+        compiles once); sentinel rows carry garbage the swap-in scatter
+        drops."""
+        t = jnp.asarray(table, jnp.int32)
+        return [
+            (np.asarray(_gather_rows(k, t)), np.asarray(_gather_rows(v, t)))
+            for k, v in self.slabs
+        ]
+
+    def swap_in(self, table: np.ndarray, host_pairs: List[Any]) -> None:
+        """Scatter host page copies back into fresh pages (sentinel
+        table rows drop)."""
+        t = jnp.asarray(table, jnp.int32)
+        self.slabs = [
+            (
+                _scatter_rows(k, t, jnp.asarray(hk)),
+                _scatter_rows(v, t, jnp.asarray(hv)),
+            )
+            for (k, v), (hk, hv) in zip(self.slabs, host_pairs)
+        ]
+
+
 class ServingEngine(LiveMetricsMixin):
     """Continuous-batching GPT serving over allocator-placed stages.
 
@@ -327,7 +483,26 @@ class ServingEngine(LiveMetricsMixin):
         devices: Optional[Sequence[Any]] = None,
         static_batching: bool = False,
         preflight: bool = True,
+        kv_layout: str = "slot",
+        page_size: int = 16,
+        num_pages: Optional[int] = None,
+        max_pages_per_request: Optional[int] = None,
+        max_concurrency: Optional[int] = None,
+        enable_prefix_cache: bool = True,
+        max_prefix_entries: int = 256,
+        preempt_policy: str = "auto",
     ):
+        if kv_layout not in ("slot", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'slot' or 'paged', got {kv_layout!r}"
+            )
+        if preempt_policy not in ("auto", "recompute", "swap"):
+            raise ValueError(
+                f"preempt_policy must be 'auto', 'recompute' or 'swap', "
+                f"got {preempt_policy!r}"
+            )
+        self.kv_layout = kv_layout
+        self._paged = kv_layout == "paged"
         modules = decode_modules(build_layer_stack(list(model_cfg)))
         if not attn_indices(modules) or not isinstance(
             modules[0], GptEmbeddings
@@ -336,6 +511,54 @@ class ServingEngine(LiveMetricsMixin):
                 "expected a GPT stack: GptEmbeddings + GptBlock_Attn units"
             )
         max_pos = _gcfg(modules[0].config).max_position_embeddings
+        if self._paged:
+            # the paged operating point: max_len becomes the PER-REQUEST
+            # virtual span (max_pages_per_request x page_size), and the
+            # pool depth decouples from it entirely — num_pages defaults
+            # to the slot layout's byte-equal footprint
+            # (num_slots x pages_for(max_len)), the equal-memory pivot
+            self.page_size = int(page_size)
+            if self.page_size < 1:
+                raise ValueError(f"page_size must be >= 1, got {page_size}")
+            if max_pages_per_request is not None:
+                self.max_pages_per_request = int(max_pages_per_request)
+            else:
+                # derived default: cover max_len, but never let the
+                # page-rounded span outgrow the model's position table
+                # — a (max_len, page_size) pair that works under the
+                # slot layout must not be rejected by its own rounding
+                derived = pages_for(max_len, self.page_size)
+                if derived * self.page_size > max_pos:
+                    derived = max_pos // self.page_size
+                if derived < 1:
+                    raise ValueError(
+                        f"page_size={self.page_size} exceeds "
+                        f"max_position_embeddings={max_pos}"
+                    )
+                self.max_pages_per_request = derived
+            max_len = self.max_pages_per_request * self.page_size
+            self.num_pages = (
+                int(num_pages) if num_pages is not None
+                else int(num_slots) * pages_for(max_len, self.page_size)
+            )
+            self.max_concurrency = (
+                int(max_concurrency) if max_concurrency is not None
+                else min(self.num_pages, int(num_slots) * 4)
+            )
+            if self.max_concurrency < 1:
+                raise ValueError(
+                    f"max_concurrency must be >= 1, "
+                    f"got {self.max_concurrency}"
+                )
+            # decode rows are the concurrency lanes: num_slots becomes
+            # the row count so the fleet's slot-accounting, router load
+            # estimates, and chaos slot leaks stay meaningful unchanged
+            num_slots = self.max_concurrency
+        else:
+            self.page_size = None
+            self.num_pages = None
+            self.max_pages_per_request = None
+            self.max_concurrency = None
         if max_len > max_pos:
             raise ValueError(
                 f"max_len={max_len} exceeds "
@@ -350,6 +573,9 @@ class ServingEngine(LiveMetricsMixin):
         self.num_slots = int(num_slots)
         self.max_len = int(max_len)
         self.pad_id = int(pad_id)
+        self.enable_prefix_cache = bool(enable_prefix_cache)
+        self.preempt_policy = preempt_policy
+        self._max_prefix_entries = int(max_prefix_entries)
         if queue_policy not in ("reject", "shed"):
             raise ValueError(
                 f"queue_policy must be 'reject' or 'shed', "
@@ -412,30 +638,64 @@ class ServingEngine(LiveMetricsMixin):
                 list(model_cfg), worker_manager,
                 (np.zeros((self.num_slots, 1), np.int32),),
                 memory="error", check_donation=False,
-                serving=dict(
-                    slots=self.num_slots, max_len=self.max_len,
-                    bucket=self.bucketer.max_bucket,
-                ),
+                serving=self._serving_context(),
             ).raise_if_failed()
         if len(params_list) != len(modules):
             raise ValueError(
                 f"got {len(params_list)} param trees for "
                 f"{len(modules)} layers"
             )
-        self.stages: List[_ServingStage] = []
+        # paged host state: ONE page pool governs the page-id space
+        # across all stages (page p = row p of every stage's slabs, the
+        # paged twin of cross-stage slot ids); rows are the decode
+        # concurrency lanes, shared as every stage's `.pool` facade so
+        # fleet slot accounting / chaos leaks work unchanged
+        if self._paged:
+            self._pool = PagedKVCachePool(
+                self.num_pages, self.page_size,
+                self.max_pages_per_request,
+                enable_prefix_cache=self.enable_prefix_cache,
+                max_prefix_entries=self._max_prefix_entries,
+            )
+            self._rows = RowAllocator(self.max_concurrency)
+            # request_id -> host page copies + resume state (swap pool)
+            self._swapped: Dict[int, Dict[str, Any]] = {}
+        else:
+            self._pool = None
+            self._rows = None
+            self._swapped = {}
+        # banked totals of pools replaced by reconfigure (counter
+        # monotonicity across geometry changes)
+        self._pool_base = dict(
+            prefix_hits=0, prefix_tokens_reused=0, cow_copies=0,
+            prefix_evictions=0,
+        )
+        self.stages: List[Any] = []
         cursor = 0
         for k, (n, dev) in enumerate(zip(counts, stage_devices)):
             # everything the traced programs depend on: the exact layer
-            # configs of this stage's slice, the cache depth, and the
-            # donation mode (the input SHAPES — bucket, slot count —
-            # are jit cache keys already, not closure identity)
+            # configs of this stage's slice, the layout, the cache
+            # depth, and the donation mode (the input SHAPES — bucket,
+            # slot/row count, page geometry — are jit cache keys
+            # already, not closure identity)
             program_key = json.dumps(
-                [self._model_cfg[cursor:cursor + n], self.max_len,
-                 bool(_donation_enabled())],
+                [self._model_cfg[cursor:cursor + n], self.kv_layout,
+                 self.max_len, bool(_donation_enabled())],
                 sort_keys=True, default=str,
             )
-            self.stages.append(
-                _ServingStage(
+            if self._paged:
+                stage = _PagedServingStage(
+                    k,
+                    modules[cursor:cursor + n],
+                    list(params_list)[cursor:cursor + n],
+                    dev,
+                    self.num_pages,
+                    self.page_size,
+                    program_key=program_key,
+                )
+                stage.pool = self._rows  # shared row ledger facade
+            else:
+                stage = _ServingStage(
                     k,
                     modules[cursor:cursor + n],
                     list(params_list)[cursor:cursor + n],
@@ -444,9 +704,22 @@ class ServingEngine(LiveMetricsMixin):
                     self.max_len,
                     program_key=program_key,
                 )
-            )
+            self.stages.append(stage)
             cursor += n
         self._last_device = self.stages[-1].device
+
+    def _serving_context(self) -> Dict[str, Any]:
+        """The operating point the pre-flight verifier charges."""
+        if self._paged:
+            return dict(
+                num_pages=self.num_pages, page_size=self.page_size,
+                max_pages_per_request=self.max_pages_per_request,
+                bucket=self.bucketer.max_bucket,
+            )
+        return dict(
+            slots=self.num_slots, max_len=self.max_len,
+            bucket=self.bucketer.max_bucket,
+        )
 
     # --- construction helpers ----------------------------------------------
     def _resolve_stage_plan(self, worker_manager, partition, n_layers):
@@ -487,6 +760,9 @@ class ServingEngine(LiveMetricsMixin):
         return self.stages[0].pool.free_slots
 
     def _allocate_slot(self) -> Optional[int]:
+        if self._paged:
+            # one shared row ledger (every stage's .pool IS self._rows)
+            return self._rows.allocate()
         slot = self.stages[0].pool.allocate()
         if slot is None:
             return None
@@ -495,6 +771,9 @@ class ServingEngine(LiveMetricsMixin):
         return slot
 
     def _release_slot(self, slot: int) -> None:
+        if self._paged:
+            self._rows.release(slot)
+            return
         for st in self.stages:
             st.pool.release(slot)
 
@@ -640,26 +919,91 @@ class ServingEngine(LiveMetricsMixin):
         self._trace_queued(request, tracer)
         return request
 
-    def preempt(self, request_id: int) -> Request:
-        """Evict a running request; it re-queues and resumes by
-        recomputing its KV prefix on re-admission (token stream intact)."""
+    def preempt(self, request_id: int,
+                mode: Optional[str] = None) -> Request:
+        """Evict a running request; it re-queues and resumes with its
+        token stream intact.
+
+        Slot layout: always recomputation-style (the KV prefix is
+        rebuilt on re-admission).  Paged layout: ``mode`` (or the
+        engine's ``preempt_policy``) picks between **recompute** and
+        **swap** — page contents copied to a host pool and paged back
+        in on re-admission, no prefill replay.  ``"auto"`` chooses by
+        resume cost (``paging.choose_preempt_mode``): recompute replays
+        ``len(effective_prompt)`` tokens of prefill, swap moves the
+        request's pages over the host link twice; a resume prefix that
+        has outgrown every bucket forces swap — the case recomputation
+        structurally cannot serve.
+        """
         request = self._running.get(request_id)
         if request is None:
             raise KeyError(f"request {request_id} is not running")
-        # validate the resume prefix fits a bucket BEFORE touching any
-        # state: a request grown past the largest bucket cannot resume
-        # by recomputation, and a failed preempt must leave it running
-        self.bucketer.bucket_for(int(request.effective_prompt.size))
+        if mode not in (None, "auto", "recompute", "swap"):
+            # validate BEFORE any state is touched: an unknown mode
+            # falling through the branches below would tear the request
+            # down and then fail to re-queue it
+            raise ValueError(
+                f"preempt mode must be 'auto', 'recompute' or 'swap', "
+                f"got {mode!r}"
+            )
+        resume_len = int(request.effective_prompt.size)
+        if not self._paged:
+            if mode not in (None, "recompute"):
+                raise ValueError(
+                    f"slot engines only preempt by recomputation, "
+                    f"got mode={mode!r}"
+                )
+            # validate the resume prefix fits a bucket BEFORE touching
+            # any state: a request grown past the largest bucket cannot
+            # resume by recomputation, and a failed preempt must leave
+            # it running
+            self.bucketer.bucket_for(resume_len)
+            mode = "recompute"
+        else:
+            try:
+                self.bucketer.bucket_for(resume_len)
+                fits = True
+            except ValueError:
+                fits = False
+            if mode is None:
+                mode = self.preempt_policy
+            if mode == "auto":
+                mode = choose_preempt_mode(
+                    resume_len, len(self._pool.table(request_id)),
+                    self.page_size, recompute_feasible=fits,
+                )
+            if mode == "recompute" and not fits:
+                # surface the same diagnostic the slot path raises
+                self.bucketer.bucket_for(resume_len)
+        swap_record = None
+        if mode == "swap":
+            # host copies BEFORE any state mutates: a sentinel-padded
+            # table keeps the gathered shape fixed, and np.asarray
+            # forces the device work before the pages are freed
+            table = np.full(
+                (self.max_pages_per_request,), self.num_pages, np.int32
+            )
+            held = self._pool.table(request_id)
+            table[: len(held)] = held
+            swap_record = dict(
+                pages=len(held), index=request.index,
+                data=[st.swap_out(table) for st in self.stages],
+            )
         self._running.pop(request_id)
         self._release_slot(request.slot)
+        if self._paged:
+            self._pool.release(request_id)
         request.slot = None
         request.preemptions += 1
         self.stats.preemptions += 1
+        if swap_record is not None:
+            self._swapped[request_id] = swap_record
+            self.stats.swap_outs += 1
         tracer = get_tracer()
         if tracer is not None:
             tracer.instant(
                 "preempt", tracer.lane("serving", "engine"),
-                {"request": request_id},
+                {"request": request_id, "mode": mode},
             )
             # the request's decode segment ends here (the engine-lane
             # preempt instant above already carries the request id, so
@@ -667,8 +1011,13 @@ class ServingEngine(LiveMetricsMixin):
             # would double trace-derived preemption counts)
             self._trace_close_decode(request, tracer, preempted=True)
         # force: the queue bound gates NEW admissions only — a preempted
-        # request is already admitted and dropping it loses its tokens
-        self._queue.submit(request, force=True)
+        # request is already admitted and dropping it loses its tokens.
+        # A swapped request needs no prefill bucket (its KV returns from
+        # the host pool verbatim), so the bucket check is skipped — that
+        # is exactly what lets swap serve resume prefixes recomputation
+        # cannot.
+        self._queue.submit(request, force=True,
+                           require_bucket=(mode != "swap"))
         self.stats.queue_depth = self._queue.depth
         self._trace_queued(request, tracer)
         return request
@@ -684,13 +1033,23 @@ class ServingEngine(LiveMetricsMixin):
         bucket cannot resume by recomputation; it STAYS RUNNING here
         (``preempt``'s validate-before-evict contract) and is not
         returned — the caller decides whether to keep stepping this
-        engine until it finishes or declare it failed."""
+        engine until it finishes or declare it failed.
+
+        Paged engines drain recomputation-style too: swap records are
+        host-local (another engine has no access to this one's host
+        pool), so migration resumes by re-prefilling the effective
+        prompt — and any swap records held for queued requests are
+        dropped with the same consequence."""
         for request_id in list(self._running):
             try:
-                self.preempt(request_id)
+                # cross-engine resume is recompute by construction
+                self.preempt(request_id, mode="recompute")
             except ValueError:
                 continue  # documented: not resumable, stays running
         drained = self._queue.drain()
+        if self._paged:
+            for r in drained:
+                self._swapped.pop(r.request_id, None)
         tracer = get_tracer()
         if tracer is not None:
             # each drained request's queue_wait segment ends HERE (on
@@ -713,6 +1072,10 @@ class ServingEngine(LiveMetricsMixin):
 
     def _finish(self, request: Request, now: float) -> None:
         self._release_slot(request.slot)
+        if self._paged:
+            # pages the radix index still references survive the
+            # release — the prefix cache's retention, not a leak
+            self._pool.release(request.request_id)
         request.slot = None
         request.status = FINISHED
         request.finished_s = now
@@ -758,15 +1121,39 @@ class ServingEngine(LiveMetricsMixin):
                     "queue_stall", tracer.lane("serving", "engine"),
                     {"queued": self._queue.depth},
                 )
-        self._admit()
-        self._decode_tick()
+        if self._paged:
+            self._admit_paged()
+            self._decode_tick_paged()
+        else:
+            self._admit()
+            self._decode_tick()
         self.stats.iterations += 1
         self.stats.queue_depth = self._queue.depth
         self.stats.batch_occupancy = self.stages[0].pool.occupancy
+        if self._paged:
+            self._sync_paged_stats()
         if self.timeseries is not None:
             self.timeseries.sample()
         if self.autotuner is not None:
             self.autotuner.on_step(self)
+
+    def _sync_paged_stats(self) -> None:
+        """Mirror the page pool's counters/gauges into ``ServingStats``
+        (one owner for the numbers — the pool — one surface for the
+        exporter).  ``_pool_base`` banks a replaced pool's totals so a
+        geometry reconfigure never makes an engine-lifetime counter go
+        backwards (the discipline ``FIELD_TYPES`` promises)."""
+        pool, base = self._pool, self._pool_base
+        self.stats.prefix_hits = base["prefix_hits"] + pool.prefix_hits
+        self.stats.prefix_tokens_reused = (
+            base["prefix_tokens_reused"] + pool.prefix_tokens_reused
+        )
+        self.stats.cow_copies = base["cow_copies"] + pool.cow_copies
+        self.stats.prefix_evictions = (
+            base["prefix_evictions"] + pool.prefix_evictions
+        )
+        self.stats.pages_in_use = pool.pages_in_use
+        self.stats.free_pages = pool.free_pages
 
     def reconfigure(
         self,
@@ -774,6 +1161,10 @@ class ServingEngine(LiveMetricsMixin):
         buckets: Optional[Sequence[int]] = None,
         num_slots: Optional[int] = None,
         prefill_batch: Optional[int] = None,
+        num_pages: Optional[int] = None,
+        page_size: Optional[int] = None,
+        max_pages_per_request: Optional[int] = None,
+        max_concurrency: Optional[int] = None,
     ) -> None:
         """Apply a new serving operating point IN PLACE, between steps.
 
@@ -796,9 +1187,39 @@ class ServingEngine(LiveMetricsMixin):
         touched, so a rejected reconfigure (:class:`PlanError` /
         ``ValueError`` / a slab-allocation failure) leaves the engine
         exactly as it was.
+
+        Paged engines (``kv_layout="paged"``) additionally learn
+        ``num_pages``/``page_size``/``max_pages_per_request``/
+        ``max_concurrency`` (``num_slots`` aliases ``max_concurrency``,
+        so the autotuner's slot proposals keep working unchanged):
+        bucket and wave-width changes stay eviction-free, a
+        concurrency change re-seats the running batch
+        recomputation-style on the SAME page pool (swap records stay
+        valid), and a page-geometry change rebuilds pool + slabs —
+        running requests resume by recomputation, the prefix cache
+        restarts cold (its counters banked, never reset), and host
+        swap records (whose page shapes died with the geometry)
+        convert to recomputation resumes only after every affected
+        request is proven to fit a prefill bucket.
         """
         from ..analysis.plan_check import verify_tuning_knobs
 
+        if self._paged:
+            self._reconfigure_paged(
+                buckets=buckets, num_slots=num_slots,
+                prefill_batch=prefill_batch, num_pages=num_pages,
+                page_size=page_size,
+                max_pages_per_request=max_pages_per_request,
+                max_concurrency=max_concurrency,
+            )
+            return
+        if any(k is not None for k in
+               (num_pages, page_size, max_pages_per_request,
+                max_concurrency)):
+            raise ValueError(
+                "page knobs (num_pages/page_size/max_pages_per_request/"
+                "max_concurrency) require kv_layout='paged'"
+            )
         if buckets is not None:
             # same normalization the constructor's ShapeBucketer applies,
             # so reconfigure accepts exactly the inputs construction
@@ -925,6 +1346,218 @@ class ServingEngine(LiveMetricsMixin):
                      evicted=len(evicted)),
             )
 
+    def _reconfigure_paged(
+        self,
+        *,
+        buckets=None,
+        num_slots=None,
+        prefill_batch=None,
+        num_pages=None,
+        page_size=None,
+        max_pages_per_request=None,
+        max_concurrency=None,
+    ) -> None:
+        """The paged half of :meth:`reconfigure` (same verify-then-
+        apply contract; see its docstring for the knob semantics)."""
+        from ..analysis.plan_check import verify_tuning_knobs
+
+        if buckets is not None:
+            try:
+                new_buckets = tuple(sorted(set(int(b) for b in buckets)))
+            except (TypeError, ValueError):
+                new_buckets = tuple(buckets)
+        else:
+            new_buckets = self.bucketer.buckets
+        if max_concurrency is not None and num_slots is not None and (
+                int(max_concurrency) != int(num_slots)):
+            raise ValueError(
+                "num_slots aliases max_concurrency on a paged engine; "
+                f"got conflicting {num_slots} and {max_concurrency}"
+            )
+        new_rows = int(
+            max_concurrency if max_concurrency is not None
+            else num_slots if num_slots is not None
+            else self.max_concurrency
+        )
+        new_batch = (
+            int(prefill_batch)
+            if prefill_batch is not None else self.prefill_batch
+        )
+        new_pages = (
+            int(num_pages) if num_pages is not None else self.num_pages
+        )
+        new_psize = (
+            int(page_size) if page_size is not None else self.page_size
+        )
+        new_mpr = (
+            int(max_pages_per_request)
+            if max_pages_per_request is not None
+            else self.max_pages_per_request
+        )
+        new_virtual = new_mpr * new_psize if (
+            isinstance(new_mpr, int) and isinstance(new_psize, int)
+            and new_mpr > 0 and new_psize > 0
+        ) else self.max_len
+        verify_tuning_knobs(
+            buckets=new_buckets, max_len=new_virtual,
+            num_slots=new_rows, prefill_batch=new_batch,
+            num_pages=new_pages, page_size=new_psize,
+            max_pages_per_request=new_mpr,
+        ).raise_if_failed()
+        max_pos = _gcfg(
+            self.stages[0].modules[0].config
+        ).max_position_embeddings
+        if new_virtual > max_pos:
+            raise ValueError(
+                f"max_pages_per_request x page_size = {new_virtual} "
+                f"exceeds max_position_embeddings={max_pos}"
+            )
+        geometry_change = (
+            new_pages != self.num_pages or new_psize != self.page_size
+            or new_mpr != self.max_pages_per_request
+        )
+        rows_change = new_rows != self.max_concurrency
+        must_evict = geometry_change or rows_change
+        if (self._preflight and self._worker_manager is not None
+                and (geometry_change
+                     or max(new_buckets) > self.bucketer.max_bucket)):
+            # ANY geometry change pre-builds a full second slab set
+            # while the old one is still resident, so the transient
+            # peak is old+new pool depth even when the new pool is
+            # SMALLER — charge exactly what the apply holds (the slot
+            # path's transient-peak rule, at page granularity)
+            from ..analysis.plan_check import verify_plan
+
+            charged = new_pages + (
+                self.num_pages if geometry_change else 0
+            )
+            verify_plan(
+                self._model_cfg, self._worker_manager,
+                (np.zeros((new_rows, 1), np.int32),),
+                memory="error", check_donation=False,
+                serving=dict(num_pages=charged, page_size=new_psize,
+                             max_pages_per_request=new_mpr,
+                             bucket=max(new_buckets)),
+            ).raise_if_failed()
+        new_bucketer = ShapeBucketer(new_buckets)
+        # feasibility BEFORE any mutation.  Swap records survive only a
+        # geometry-preserving change; under a geometry change every
+        # swapped request must be able to resume by recomputation.
+        live = list(self._running.values()) + list(self._queue.requests)
+        for r in live:
+            length = int(r.effective_prompt.size)
+            swapped = r.request_id in self._swapped
+            if length + r.remaining > new_virtual:
+                raise ValueError(
+                    f"reconfigure rejected: request {r.request_id} "
+                    f"spans {length + r.remaining} positions; the new "
+                    f"virtual span is {new_virtual}"
+                )
+            if swapped and not geometry_change:
+                continue  # resumes from host pages, needs no bucket
+            try:
+                new_bucketer.bucket_for(length)
+            except ValueError as exc:
+                raise ValueError(
+                    f"reconfigure rejected: request {r.request_id} "
+                    f"cannot resume under buckets {list(new_buckets)}: "
+                    f"{exc}"
+                ) from None
+        # pre-build everything fallible BEFORE touching request state
+        new_slabs = (
+            [st.build_slabs(new_pages, new_psize) for st in self.stages]
+            if geometry_change else None
+        )
+        new_pool = (
+            PagedKVCachePool(
+                new_pages, new_psize, new_mpr,
+                enable_prefix_cache=self.enable_prefix_cache,
+                max_prefix_entries=self._max_prefix_entries,
+            )
+            if geometry_change else None
+        )
+        new_row_alloc = RowAllocator(new_rows) if must_evict else None
+
+        tracer = get_tracer()
+        old = dict(buckets=list(self.bucketer.buckets),
+                   max_concurrency=self.max_concurrency,
+                   prefill_batch=self.prefill_batch,
+                   num_pages=self.num_pages, page_size=self.page_size,
+                   max_pages_per_request=self.max_pages_per_request)
+        evicted: List[Request] = []
+        if must_evict:
+            for r in list(self._running.values()):
+                self._running.pop(r.request_id)
+                self._release_slot(r.slot)
+                self._pool.release(r.request_id)
+                r.slot = None
+                r.preemptions += 1
+                self.stats.preemptions += 1
+                evicted.append(r)
+                if tracer is not None:
+                    tracer.instant(
+                        "preempt", tracer.lane("serving", "engine"),
+                        {"request": r.request_id, "reconfigure": True},
+                    )
+                    self._trace_close_decode(r, tracer,
+                                             reconfigure=True)
+        queued = self._queue.drain()
+        if tracer is not None:
+            for r in queued:
+                self._trace_close_queue(r, tracer, rebucketed=True)
+        if geometry_change:
+            # bank the dying pool's counters (monotonic discipline),
+            # then swap in the cold pool + fresh slabs; swap records'
+            # page shapes died with the geometry -> recompute resumes
+            self._pool_base["prefix_hits"] += self._pool.prefix_hits
+            self._pool_base["prefix_tokens_reused"] += (
+                self._pool.prefix_tokens_reused
+            )
+            self._pool_base["cow_copies"] += self._pool.cow_copies
+            self._pool_base["prefix_evictions"] += (
+                self._pool.prefix_evictions
+            )
+            self._pool = new_pool
+            for st, slabs in zip(self.stages, new_slabs):
+                st.num_pages = new_pages
+                st.page_size = new_psize
+                st.slabs = slabs
+            self._swapped.clear()
+            self.num_pages = new_pages
+            self.page_size = new_psize
+            self.max_pages_per_request = new_mpr
+            self.max_len = new_virtual
+        if new_row_alloc is not None:
+            self._rows = new_row_alloc
+            for st in self.stages:
+                st.pool = self._rows
+            self.max_concurrency = new_rows
+            self.num_slots = new_rows
+        self.bucketer = new_bucketer
+        self.prefill_batch = new_batch
+        self._queue = AdmissionQueue(new_bucketer, prefill_batch=new_batch,
+                                     max_queue=self.max_queue)
+        for r in evicted + queued:
+            self._queue.submit(
+                r, force=True,
+                require_bucket=not (
+                    r.request_id in self._swapped
+                ),
+            )
+            self._trace_queued(r, tracer)
+        self.stats.queue_depth = self._queue.depth
+        if tracer is not None:
+            tracer.instant(
+                "reconfigure", tracer.lane("serving", "engine"),
+                dict(old=old,
+                     new=dict(buckets=list(new_buckets),
+                              max_concurrency=new_rows,
+                              prefill_batch=new_batch,
+                              num_pages=new_pages, page_size=new_psize,
+                              max_pages_per_request=new_mpr),
+                     evicted=len(evicted)),
+            )
+
     def run(
         self,
         requests: Optional[Sequence[Request]] = None,
@@ -956,13 +1589,21 @@ class ServingEngine(LiveMetricsMixin):
 
     # --- live observability (LiveMetricsMixin provides the wiring) ----------
     def _health_snapshot(self) -> Dict[str, Any]:
-        return dict(
+        snap = dict(
             status="ok",
             queue_depth=self._queue.depth,
             running=len(self._running),
             free_slots=self.free_slots,
             iterations=self.stats.iterations,
         )
+        if self._paged:
+            snap.update(
+                kv_layout="paged",
+                free_pages=self._pool.free_pages,
+                pages_in_use=self._pool.pages_in_use,
+                swapped=len(self._swapped),
+            )
+        return snap
 
     # --- internals ----------------------------------------------------------
     def _admit(self) -> None:
@@ -1101,6 +1742,338 @@ class ServingEngine(LiveMetricsMixin):
                     "decode", tracer.lane(st.lane_name, "dispatch"), stage0
                 )
         logits = data[:, 0]  # [slots, V]
+        nxt = _argmax_tokens(logits)
+        jax.block_until_ready(nxt)
+        now = time.perf_counter()
+        self.stats.decode_s += now - t0
+        if tracer is not None:
+            tracer.complete(
+                "decode", tracer.lane("serving", "engine"), span0,
+                {"active": len(active)},
+            )
+        self.stats.decode_tokens += len(active)
+        self.stats.generated_tokens += len(active)
+        self.stats.compiles += xla_compile_count() - compiles0
+
+        nxt_np = np.asarray(nxt)
+        sampled = self._sampled_rows(
+            logits, [(r.slot, r) for r in active]
+        )
+        for r in active:
+            tok = self._pick_token(r, nxt_np[r.slot],
+                                   sampled.get(r.slot))
+            r.tokens.append(tok)
+            r.index += 1
+            if r.done:
+                self._finish(r, now)
+
+    # --- the paged scheduling loop ------------------------------------------
+    def _admit_paged(self) -> None:
+        """Admit from the queue while rows AND pages allow — admission
+        charges PAGES (the request's reserved footprint), so
+        concurrency floats with actual memory use instead of a slot
+        count.  FIFO: the head either admits (prefill wave or swap-in)
+        or stalls the queue — a later small request never jumps a
+        starved head."""
+        if self.static_batching and self._running:
+            return  # batch boundary only: the naive baseline policy
+        while True:
+            queued = self._queue.requests
+            if not queued or self._rows.free_slots < 1:
+                return
+            head = queued[0]
+            if head.request_id in self._swapped:
+                if not self._swap_in(head):
+                    self._stall_on_pages()
+                    return
+                continue
+            wave = self._select_paged_wave()
+            if wave is None:
+                self._stall_on_pages()
+                return
+            self._prefill_wave_paged(wave)
+
+    @staticmethod
+    def _effective_tokens(request: Request) -> tuple:
+        """The request's effective prompt as a token tuple (radix-cache
+        key), cached until its generated-token count changes — wave
+        selection re-scans the queue every stalled tick, and rebuilding
+        O(prompt) int lists per scan would put host work proportional
+        to queue depth x prompt length on the scheduling path."""
+        n = len(request.tokens)
+        cached = getattr(request, "_token_cache", None)
+        if cached is not None and cached[0] == n:
+            return cached[1]
+        tokens = tuple(int(t) for t in request.effective_prompt)
+        request._token_cache = (n, tokens)
+        return tokens
+
+    def _stall_on_pages(self) -> None:
+        """Count a page-exhaustion stall (the row-exhaustion twin is
+        counted by ``step``; rows were free here, pages were not)."""
+        self.stats.queue_stalls += 1
+        tracer = get_tracer()
+        if tracer is not None:
+            tracer.instant(
+                "queue_stall", tracer.lane("serving", "engine"),
+                {"queued": self._queue.depth,
+                 "free_pages": self._pool.free_pages},
+            )
+
+    def _select_paged_wave(self) -> Optional[List[Any]]:
+        """Dequeue the next prefill wave under the paged layout, or
+        None when the head cannot be charged.
+
+        The head's TAIL bucket (prompt minus its radix-shared prefix)
+        fixes the wave's compile shape; later queued requests whose
+        tails land in the same bucket pack in, each charged its own
+        page grant.  Buckets are pure compile-shape classes here —
+        admission capacity is pages + rows, never 'a slot of the
+        head's size' (the decoupling the slot layout could not offer).
+        """
+        queued = self._queue.requests
+        head = queued[0]
+        cap = min(self.prefill_batch, self._rows.free_slots)
+        wave: List[Any] = []
+        bucket: Optional[int] = None
+        for r in queued:
+            if len(wave) >= cap:
+                break
+            if r.request_id in self._swapped:
+                continue  # swap-ins ride their own admission path
+            tokens = self._effective_tokens(r)
+            length = len(tokens)
+            if bucket is not None:
+                # cheap pre-screen before charging pages
+                peek = self._pool.peek_shared(tokens)
+                if self.bucketer.bucket_for(
+                        max(1, length - peek)) != bucket:
+                    continue
+            grant = self._pool.acquire(
+                r.request_id, tokens, length + r.remaining
+            )
+            if grant is None:
+                if r is head:
+                    return None  # head starves -> the queue stalls
+                break  # pages ran out mid-pack; serve what we have
+            tail_bucket = self.bucketer.bucket_for(
+                length - grant.shared_tokens
+            )
+            if bucket is None:
+                bucket = tail_bucket
+            elif tail_bucket != bucket:
+                # the peek promised this bucket but the grant (made
+                # under eviction) disagreed: hand the pages back with
+                # the hit counters reversed and move on
+                self._pool.rollback_grant(grant)
+                continue
+            wave.append((r, grant))
+        if not wave:
+            return None
+        for r, _ in wave:
+            self._queue.remove(r)
+        return wave
+
+    def _prefill_wave_paged(self, wave: List[Any]) -> None:
+        """Prefill a wave of (request, grant) pairs: COW-clone partial
+        shared pages, compute ONLY the non-shared tails, scatter their
+        K/V through the page tables, and seat each request on a decode
+        row.  A full-prefix hit costs one bucket of tail compute — the
+        TTFT-drops-with-prefix-length effect the bench gates."""
+        rows = self.prefill_batch
+        tails = [
+            r.effective_prompt[g.shared_tokens:] for r, g in wave
+        ]
+        bucket = self.bucketer.bucket_for(int(tails[0].size))
+        ids, lengths = self.bucketer.pad_batch(
+            tails, bucket, rows, self.pad_id
+        )
+        sentinel = self.num_pages
+        tables = np.full(
+            (rows, self.max_pages_per_request), sentinel, np.int32
+        )
+        index = np.zeros((rows,), np.int32)
+        valid = np.zeros((rows,), np.int32)  # pad rows: every write drops
+        for i, (r, g) in enumerate(wave):
+            row = self._rows.allocate()
+            assert row is not None  # wave capped by free rows
+            r.slot = row
+            tables[i, : len(g.page_table)] = g.page_table
+            index[i] = g.shared_tokens
+            valid[i] = g.shared_tokens + int(tails[i].size)
+        # copy-on-write BEFORE any dispatch touches the slabs: the
+        # donor's partial page becomes the sharer's private page, so
+        # the tail prefill's appends never write a shared page
+        for _, g in wave:
+            if g.cow_src is not None:
+                for st in self.stages:
+                    st.cow_copy(g.cow_src, g.cow_dst)
+
+        tracer = get_tracer()
+        span0 = tracer.now() if tracer is not None else 0.0
+        t0 = time.perf_counter()
+        compiles0 = xla_compile_count()
+        data: Any = ids
+        for st in self.stages:
+            data = device_put_elided(data, st.device)
+            tb = device_put_elided(tables, st.device)
+            ix = device_put_elided(index, st.device)
+            vl = device_put_elided(valid, st.device)
+            if tracer is None:
+                data, st.slabs = st._step_donated(
+                    st.params, data, st.slabs, tb, ix, vl
+                )
+            else:
+                stage0 = tracer.now()
+                data, st.slabs = st._step_donated(
+                    st.params, data, st.slabs, tb, ix, vl
+                )
+                tracer.complete(
+                    "prefill", tracer.lane(st.lane_name, "dispatch"),
+                    stage0, {"bucket": bucket},
+                )
+        pos = device_put_elided(lengths - 1, self._last_device)
+        logits = _gather_last(data, pos)  # [rows, V]
+        tokens = _argmax_tokens(logits)
+        jax.block_until_ready(tokens)
+        now = time.perf_counter()
+        self.stats.prefill_s += now - t0
+        wave_tokens = int(sum(int(t.size) for t in tails))
+        shared_tokens = int(sum(g.shared_tokens for _, g in wave))
+        if tracer is not None:
+            end_us = tracer.now()
+            tracer.complete(
+                "prefill", tracer.lane("serving", "engine"), span0,
+                {"bucket": bucket, "wave": len(wave),
+                 "tokens": wave_tokens, "shared": shared_tokens,
+                 "requests": [r.request_id for r, _ in wave]},
+                dur_us=end_us - span0,
+            )
+            for r, g in wave:
+                tracer.instant(
+                    "admit", tracer.lane("serving", "engine"),
+                    {"request": r.request_id, "slot": r.slot,
+                     "pages": len(g.page_table),
+                     "shared": g.shared_tokens},
+                )
+                self._trace_close_queue(r, tracer, end_us=span0)
+                lane = tracer.request_lane(r.request_id, lease=False)
+                if lane is not None:
+                    tracer.complete(
+                        "prefill", lane, span0,
+                        {"request": r.request_id,
+                         "replica": self.trace_name,
+                         "bucket": bucket, "slot": r.slot,
+                         "shared": g.shared_tokens},
+                        dur_us=end_us - span0,
+                    )
+                r.trace_marks["decode"] = end_us
+        self.stats.prefill_waves += 1
+        self.stats.prefill_tokens += wave_tokens
+        self.stats.compiles += xla_compile_count() - compiles0
+
+        tokens_np = np.asarray(tokens)
+        sampled = self._sampled_rows(
+            logits, [(i, r) for i, (r, _) in enumerate(wave)]
+        )
+        for i, (r, g) in enumerate(wave):
+            # index the radix cache BEFORE the done-check can release
+            # the pages: a request that finishes in its prefill tick
+            # still leaves its prompt warm for the next sharer
+            self._pool.register_prefix(
+                r.request_id, [int(t) for t in r.prompt]
+            )
+            tok = self._pick_token(r, tokens_np[i], sampled.get(i))
+            r.tokens.append(tok)
+            r.index = int(valid[i])
+            r.status = RUNNING
+            self._running[r.request_id] = r
+            if r.first_token_s is None:
+                r.first_token_s = now
+            self.stats.generated_tokens += 1
+            if r.done:
+                self._finish(r, now)
+
+    def _swap_in(self, request: Request) -> bool:
+        """Re-seat a swapped-out request: fresh pages, host copies
+        scattered back, NO prefill — decoding continues from exactly
+        where the swap-out left it.  False (nothing mutated) when the
+        pages cannot be charged yet."""
+        record = self._swapped[request.request_id]
+        pages = self._pool.acquire_pages(
+            request.request_id, record["pages"]
+        )
+        if pages is None:
+            return False
+        row = self._rows.allocate()
+        assert row is not None  # caller checked free rows
+        table = np.full(
+            (self.max_pages_per_request,), self.num_pages, np.int32
+        )
+        table[: len(pages)] = pages
+        for st, host_pairs in zip(self.stages, record["data"]):
+            st.swap_in(table, host_pairs)
+        del self._swapped[request.request_id]
+        self._queue.remove(request)
+        request.slot = row
+        request.index = record["index"]
+        request.status = RUNNING
+        self._running[request.request_id] = request
+        self.stats.swap_ins += 1
+        self.stats.queue_depth = self._queue.depth
+        tracer = get_tracer()
+        if tracer is not None:
+            now_us = tracer.now()
+            tracer.instant(
+                "swap_in", tracer.lane("serving", "engine"),
+                {"request": request.request_id, "pages": len(pages)},
+            )
+            self._trace_close_queue(request, tracer, swapped_in=True)
+            request.trace_marks["decode"] = now_us
+        return True
+
+    def _decode_tick_paged(self) -> None:
+        active = list(self._running.values())
+        if not active:
+            return
+        rows = self.max_concurrency
+        sentinel = self.num_pages
+        tokens = np.zeros((rows,), np.int32)
+        index = np.zeros((rows,), np.int32)
+        valid = np.zeros((rows,), np.int32)  # inactive rows never write
+        tables = np.full(
+            (rows, self.max_pages_per_request), sentinel, np.int32
+        )
+        for r in active:
+            tokens[r.slot] = r.tokens[-1]
+            index[r.slot] = r.index
+            valid[r.slot] = r.index + 1
+            held = self._pool.table(r.request_id)
+            tables[r.slot, : len(held)] = held
+
+        tracer = get_tracer()
+        span0 = tracer.now() if tracer is not None else 0.0
+        t0 = time.perf_counter()
+        compiles0 = xla_compile_count()
+        data: Any = tokens[:, None]  # [rows, 1]
+        for st in self.stages:
+            data = device_put_elided(data, st.device)
+            tb = device_put_elided(tables, st.device)
+            ix = device_put_elided(index, st.device)
+            vl = device_put_elided(valid, st.device)
+            if tracer is None:
+                data, st.slabs = st._step_donated(
+                    st.params, data, st.slabs, tb, ix, vl
+                )
+            else:
+                stage0 = tracer.now()
+                data, st.slabs = st._step_donated(
+                    st.params, data, st.slabs, tb, ix, vl
+                )
+                tracer.complete(
+                    "decode", tracer.lane(st.lane_name, "dispatch"), stage0
+                )
+        logits = data[:, 0]  # [rows, V]
         nxt = _argmax_tokens(logits)
         jax.block_until_ready(nxt)
         now = time.perf_counter()

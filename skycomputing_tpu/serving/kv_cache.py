@@ -101,6 +101,87 @@ def decode_positions(index, query_len: int):
 
 
 # --------------------------------------------------------------------------
+# paged cache math (used inside jitted layer code; host bookkeeping —
+# the allocator, refcounts, radix prefix index — lives in serving/paging.py)
+# --------------------------------------------------------------------------
+
+
+def paged_update_kv(
+    k_slab, v_slab, k_new, v_new, page_table, index, valid_len
+):
+    """Scatter ``k_new``/``v_new`` into paged slabs through page tables.
+
+    ``k_slab``/``v_slab``: [num_pages, page_size, heads, head_dim]
+    physical page pools; ``k_new``/``v_new``: [R, Lq, heads, head_dim];
+    ``page_table``: [R, max_pages] int32, logical page -> physical page,
+    padded with an out-of-range sentinel (>= num_pages);
+    ``index``: [R] start position of each row's new tokens;
+    ``valid_len``: [R] true end position — writes at or beyond it (the
+    pad tail of a bucketed prefill) are DROPPED, so pad positions never
+    touch a page and a row never writes outside the pages it holds.
+    Returns the updated ``(k_slab, v_slab)``.
+
+    Rows never write a page mapped by another holder: the pool's grant
+    contract (serving/paging.py) keeps shared pages read-only — a
+    partial shared page is copied-on-write into a private page before
+    the owner's first append — so scatter destinations are disjoint
+    across rows by construction and scatter order cannot matter.
+    """
+    num_pages, page_size = k_slab.shape[0], k_slab.shape[1]
+    R, Lq = k_new.shape[0], k_new.shape[1]
+    max_pages = page_table.shape[1]
+    pos = jnp.reshape(index, (-1, 1)) + jnp.arange(Lq, dtype=jnp.int32)
+    logical = pos // page_size
+    phys = jnp.take_along_axis(
+        page_table, jnp.clip(logical, 0, max_pages - 1), axis=1
+    )
+    flat = phys * page_size + pos % page_size
+    oob = num_pages * page_size  # 'drop' sentinel destination
+    keep = (
+        (pos < jnp.reshape(valid_len, (-1, 1)))
+        & (logical < max_pages)
+        & (phys >= 0) & (phys < num_pages)
+    )
+    flat = jnp.where(keep, flat, oob).reshape(-1)
+
+    def scatter(slab, new):
+        flat_slab = slab.reshape((num_pages * page_size,) + slab.shape[2:])
+        flat_slab = flat_slab.at[flat].set(
+            new.astype(slab.dtype).reshape((R * Lq,) + new.shape[2:]),
+            mode="drop",
+        )
+        return flat_slab.reshape(slab.shape)
+
+    return scatter(k_slab, k_new), scatter(v_slab, v_new)
+
+
+def gather_kv_pages(k_slab, v_slab, page_table):
+    """Per-row virtual cache views through page tables.
+
+    Returns ``(k, v)`` of shape [R, max_pages * page_size, heads,
+    head_dim]: row r's logically-contiguous sequence, assembled by
+    gathering its pages.  Sentinel table entries clamp into the slab and
+    read garbage — those virtual positions are at or beyond the row's
+    current length by the pool's covering invariant, so
+    :func:`decode_visibility` masks them exactly like the slot layout
+    masks a freed row's stale tail.
+    """
+    num_pages, page_size = k_slab.shape[0], k_slab.shape[1]
+    R = page_table.shape[0]
+    pos = (
+        page_table[:, :, None] * page_size
+        + jnp.arange(page_size, dtype=jnp.int32)[None, None, :]
+    )
+    pos = jnp.clip(pos.reshape(R, -1), 0, num_pages * page_size - 1)
+
+    def gather(slab):
+        flat = slab.reshape((num_pages * page_size,) + slab.shape[2:])
+        return flat[pos]
+
+    return gather(k_slab), gather(v_slab)
+
+
+# --------------------------------------------------------------------------
 # slab specification + allocation
 # --------------------------------------------------------------------------
 
@@ -225,6 +306,41 @@ class SlotKVCachePool:
         )
 
 
+def init_paged_caches(
+    specs: Sequence[KVCacheSpec],
+    num_pages: int,
+    page_size: int,
+    device=None,
+) -> List[Tuple[jax.Array, jax.Array]]:
+    """Zeroed paged (k, v) slab pairs ``[num_pages, page_size, heads,
+    head_dim]``, one per attention layer.  Same total bytes as a slot
+    slab whenever ``num_pages * page_size == slots * max_len`` — the
+    equal-memory pivot the paged-vs-slot bench holds fixed."""
+    caches = []
+    for spec in specs:
+        shape = (num_pages, page_size, spec.num_heads, spec.head_dim)
+        dtype = jnp.dtype(spec.dtype)
+        pair = (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+        if device is not None:
+            pair = jax.device_put(pair, device)
+        caches.append(pair)
+    return caches
+
+
+def paged_kv_mb_per_layer(
+    model_cfg: Sequence[dict],
+    num_pages: int,
+    page_size: int,
+    attn_layer_type: str = "GptBlock_Attn",
+) -> List[float]:
+    """Per-layer paged-pool MB for a layer-config list — the paged twin
+    of :func:`kv_mb_per_layer` (the pool is ``num_pages x page_size``
+    positions instead of ``slots x max_len``, byte-identical formula)."""
+    return kv_mb_per_layer(
+        model_cfg, num_pages, page_size, attn_layer_type=attn_layer_type
+    )
+
+
 def kv_mb_per_layer(
     model_cfg: Sequence[dict],
     slots: int,
@@ -253,8 +369,12 @@ __all__ = [
     "SlotKVCachePool",
     "decode_positions",
     "decode_visibility",
+    "gather_kv_pages",
     "init_layer_caches",
+    "init_paged_caches",
     "kv_mb_per_layer",
     "kv_spec_from_config",
+    "paged_kv_mb_per_layer",
+    "paged_update_kv",
     "update_kv_cache",
 ]

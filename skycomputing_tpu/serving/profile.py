@@ -56,14 +56,33 @@ class DecodeModelBenchmarker(BaseBenchmarker):
         max_len: int,
         param_scale: int = 2,
         attn_layer_type: str = "GptBlock_Attn",
+        num_pages: Optional[int] = None,
+        page_size: Optional[int] = None,
     ):
         if slots < 1 or max_len < 1:
             raise ValueError(
                 f"need positive slots/max_len, got {slots}/{max_len}"
             )
+        if (num_pages is None) != (page_size is None):
+            raise ValueError(
+                "pass num_pages AND page_size together (the paged "
+                "operating point) or neither (slot layout)"
+            )
+        if num_pages is not None and (num_pages < 1 or page_size < 1):
+            raise ValueError(
+                f"need positive num_pages/page_size, got "
+                f"{num_pages}/{page_size}"
+            )
         self._model_config = model_config
+        # paged engines: `slots` is the decode-row count
+        # (max_concurrency) and `max_len` the per-request virtual span
+        # (max_pages_per_request x page_size) — together they fix the
+        # decode-step compute exactly like the slot layout's operating
+        # point does; only the MEMORY charge changes, to the page pool
         self._slots = int(slots)
         self._max_len = int(max_len)
+        self._num_pages = None if num_pages is None else int(num_pages)
+        self._page_size = None if page_size is None else int(page_size)
         self._param_scale = int(param_scale)
         self._attn_layer_type = attn_layer_type
         self._result: Optional[Tuple[List[float], List[float]]] = None
@@ -74,10 +93,15 @@ class DecodeModelBenchmarker(BaseBenchmarker):
 
     @property
     def operating_point(self) -> Dict[str, int]:
-        """The (slots, max_len) the profile was taken at — stamped into
-        bench provenance so a partition is never reused at a different
+        """The (slots, max_len) — plus (num_pages, page_size) under the
+        paged layout — the profile was taken at, stamped into bench
+        provenance so a partition is never reused at a different
         serving configuration without re-solving."""
-        return dict(slots=self._slots, max_len=self._max_len)
+        point = dict(slots=self._slots, max_len=self._max_len)
+        if self._num_pages is not None:
+            point.update(num_pages=self._num_pages,
+                         page_size=self._page_size)
+        return point
 
     def benchmark(self) -> Tuple[List[float], List[float]]:
         if self._result is not None:
@@ -87,10 +111,22 @@ class DecodeModelBenchmarker(BaseBenchmarker):
 
     def _benchmark(self) -> Tuple[List[float], List[float]]:
         S = self._slots
-        kv_mb = kv_mb_per_layer(
-            self._model_config, S, self._max_len,
-            attn_layer_type=self._attn_layer_type,
-        )
+        if self._num_pages is not None:
+            # the paged pool's footprint replaces the slot slabs (the
+            # same formula plan_check charges, so allocator and
+            # verifier can never disagree on pool size); compute cost
+            # below still profiles at (rows, virtual span)
+            from .kv_cache import paged_kv_mb_per_layer
+
+            kv_mb = paged_kv_mb_per_layer(
+                self._model_config, self._num_pages, self._page_size,
+                attn_layer_type=self._attn_layer_type,
+            )
+        else:
+            kv_mb = kv_mb_per_layer(
+                self._model_config, S, self._max_len,
+                attn_layer_type=self._attn_layer_type,
+            )
         index = jax.ShapeDtypeStruct((S,), np.int32)
         # the decode wavefront: token ids enter the first layer, hidden
         # state threads through the rest — exactly the engine's tick

@@ -25,6 +25,7 @@ from skycomputing_tpu.models.gpt import (
 from skycomputing_tpu.parallel.pipeline import xla_compile_count
 from skycomputing_tpu.serving import (
     KVCacheSpec,
+    PagedKVCachePool,
     Request,
     ServingEngine,
     ShapeBucketer,
@@ -32,6 +33,14 @@ from skycomputing_tpu.serving import (
 )
 
 pytestmark = pytest.mark.serving
+
+
+def paged_engine(layer_cfgs, params, **kw):
+    """A paged-layout engine with small-test defaults."""
+    base = dict(num_slots=3, max_len=48, buckets=(8, 16),
+                kv_layout="paged", page_size=8)
+    base.update(kw)
+    return ServingEngine(layer_cfgs, params, **base)
 
 
 @pytest.fixture(scope="module")
@@ -394,6 +403,291 @@ def test_serving_allocate_balances_decode_costs(gpt, devices):
 # --------------------------------------------------------------------------
 # benchmark smoke (the perf-marker path)
 # --------------------------------------------------------------------------
+
+
+# --------------------------------------------------------------------------
+# paged KV cache + prefix reuse
+# --------------------------------------------------------------------------
+
+
+def test_paging_pool_contract():
+    """Host bookkeeping: grants charge ceil(len/page_size) pages, a
+    radix hit maps shared pages by refcount with a COW clone for the
+    partial tail page, exhaustion returns None without mutating, LRU
+    eviction reclaims cache-only pages, and the refcount audit holds
+    at every step."""
+    pool = PagedKVCachePool(num_pages=8, page_size=4,
+                            max_pages_per_request=6)
+    g1 = pool.acquire(1, list(range(10)), 15)
+    assert len(g1.page_table) == 4 and g1.shared_tokens == 0
+    pool.register_prefix(1, list(range(10)))
+    pool.check_consistency()
+    g2 = pool.acquire(2, list(range(10)) + [99, 98], 14)
+    assert g2.shared_tokens == 10 and g2.shared_pages == 2
+    assert g2.page_table[:2] == g1.page_table[:2]  # mapped, not copied
+    assert g2.cow_src == g1.page_table[2]  # partial page -> COW clone
+    assert g2.cow_dst == g2.new_pages[0]
+    assert pool.prefix_hits == 1 and pool.prefix_tokens_reused == 10
+    pool.check_consistency()
+    # uncoverable acquire: None, nothing mutated (cache not spent)
+    evictions = pool.prefix_evictions
+    assert pool.acquire(3, [7, 7, 7], 20) is None
+    assert pool.prefix_evictions == evictions
+    pool.check_consistency()
+    # cache retention: releasing the donor keeps its prompt pages
+    assert pool.release(1) == 1
+    # pressure evicts the LRU entry and the grant lands
+    assert pool.acquire(3, [7, 7, 7], 16) is not None
+    assert pool.prefix_evictions == evictions + 1
+    pool.release(2)
+    pool.release(3)
+    pool.check_consistency()
+    assert pool.free_pages == 8
+    with pytest.raises(KeyError):
+        pool.release(42)
+
+
+def test_paged_token_identity_and_page_exhaustion_queues(gpt):
+    """More requests than the page pool holds: admission queues on
+    page exhaustion (never corrupts), every request still finishes
+    token-identical to its one-shot decode, and the refcount audit
+    passes after the drain."""
+    layer_cfgs, params, fwd = gpt
+    engine = paged_engine(layer_cfgs, params, num_pages=6,
+                          max_concurrency=8)
+    rng = np.random.default_rng(11)
+    requests = mixed_requests(
+        rng, [(4, 6), (5, 3), (12, 8), (6, 2), (2, 5), (9, 4)]
+    )
+    for r in requests:
+        engine.submit(r)
+    pages_seen = []
+    while engine.has_work():
+        engine.step()
+        pages_seen.append(engine._pool.pages_in_use)
+    assert max(pages_seen) <= 6  # the pool never over-allocates
+    assert engine.stats.queue_stalls > 0  # exhaustion queued
+    assert engine.stats.finished == len(requests)
+    for r in requests:
+        np.testing.assert_array_equal(r.output(), reference(fwd, r))
+    engine._pool.check_consistency()
+
+
+def test_paged_prefix_reuse_cow_identity(gpt):
+    """A request sharing a system prompt with an earlier one is
+    token-identical to its unshared twin, while the radix cache counts
+    the hit, the reused tokens, and the COW clone that kept the shared
+    partial page read-only."""
+    layer_cfgs, params, fwd = gpt
+    engine = paged_engine(layer_cfgs, params, buckets=(8, 16, 32))
+    rng = np.random.default_rng(12)
+    system = rng.integers(1, 512, (18,)).astype(np.int32)
+    first = Request(
+        prompt=np.concatenate(
+            [system, rng.integers(1, 512, (3,)).astype(np.int32)]),
+        max_new_tokens=6,
+    )
+    engine.run([first])
+    assert engine.stats.prefix_hits == 0
+    twin_prompt = np.concatenate(
+        [system, rng.integers(1, 512, (4,)).astype(np.int32)]
+    )
+    shared = Request(prompt=twin_prompt.copy(), max_new_tokens=6)
+    engine.run([shared])
+    snap = engine.stats.snapshot()
+    assert snap["prefix_hits"] == 1
+    # token-granular sharing: the whole 18-token system prompt plus the
+    # matching span of the first request's tail (if any) is reused
+    assert snap["prefix_tokens_reused"] >= 18
+    assert snap["cow_copies"] >= 1  # 18 % 8 != 0 -> partial page clone
+    # the shared-prefix request equals its UNSHARED twin: one-shot
+    # decode of the same prompt on a fresh reference
+    np.testing.assert_array_equal(shared.output(), reference(fwd, shared))
+    np.testing.assert_array_equal(first.output(), reference(fwd, first))
+    engine._pool.check_consistency()
+
+
+def test_paged_swap_and_recompute_preempt_identity(gpt):
+    """Swap-preempted and recompute-preempted requests both resume
+    with identical token streams; swap round-trips through the host
+    pool without prefill, recompute re-prefills (and may hit its own
+    cached prompt)."""
+    layer_cfgs, params, fwd = gpt
+    engine = paged_engine(layer_cfgs, params)
+    rng = np.random.default_rng(13)
+    swap_victim, recompute_victim, bystander = mixed_requests(
+        rng, [(6, 10), (5, 9), (4, 4)]
+    )
+    for r in (swap_victim, recompute_victim, bystander):
+        engine.submit(r)
+    for _ in range(3):
+        engine.step()
+    assert not swap_victim.done and not recompute_victim.done
+    # an unknown mode is rejected BEFORE any state is touched — a
+    # fall-through here would tear the request down un-requeueable
+    with pytest.raises(ValueError, match="preempt mode"):
+        engine.preempt(swap_victim.request_id, mode="Swap")
+    assert swap_victim.request_id in engine._running
+    engine.preempt(swap_victim.request_id, mode="swap")
+    engine.preempt(recompute_victim.request_id, mode="recompute")
+    assert engine.stats.swap_outs == 1
+    assert swap_victim.request_id in engine._swapped
+    engine.run()
+    assert engine.stats.swap_ins == 1
+    assert not engine._swapped
+    for r in (swap_victim, recompute_victim, bystander):
+        np.testing.assert_array_equal(r.output(), reference(fwd, r))
+    engine._pool.check_consistency()
+
+
+def test_paged_zero_steady_state_recompiles(gpt):
+    """After one warmup request per bucket (distinct leading tokens so
+    the prefix cache cannot collapse a bucket's tail into a smaller
+    one) plus a shared-prefix pair (warms the COW copy program), a
+    mixed wave with live prefix hits runs with ZERO XLA compiles."""
+    layer_cfgs, params, fwd = gpt
+    engine = paged_engine(layer_cfgs, params, prefill_batch=2)
+    rng = np.random.default_rng(14)
+    for b in (8, 16):
+        engine.run([Request(prompt=np.full((b,), b + 1, np.int32),
+                            max_new_tokens=2)])
+    system = rng.integers(1, 512, (12,)).astype(np.int32)
+    for _ in range(2):  # 2nd hits the 1st's prefix -> COW program warm
+        engine.run([Request(
+            prompt=np.concatenate(
+                [system, rng.integers(1, 512, (2,)).astype(np.int32)]),
+            max_new_tokens=2)])
+    assert engine.stats.prefix_hits >= 1  # the warmup pair really hit
+    warm = xla_compile_count()
+    wave = mixed_requests(rng, [(6, 8), (2, 3), (15, 5), (9, 4), (11, 2)])
+    outputs = engine.run(wave)
+    assert xla_compile_count() == warm, (
+        "steady-state paged serving recompiled after warmup"
+    )
+    for r in wave:
+        np.testing.assert_array_equal(
+            outputs[r.request_id], reference(fwd, r)
+        )
+
+
+def test_paged_admission_decouples_buckets_from_capacity(gpt):
+    """Buckets are pure compile-shape classes under paged admission:
+    a short prompt padded to a bucket charges pages for its TRUE span,
+    so four requests whose bucket-padded sizes would blow a slot pool
+    all run concurrently on the pages their tokens actually need."""
+    layer_cfgs, params, fwd = gpt
+    # pool = 4 pages x 8 positions = 32 positions; each request spans
+    # <= 8 positions (1 page) but pads to the 16-bucket for compile
+    engine = paged_engine(layer_cfgs, params, num_pages=4,
+                          max_pages_per_request=2, buckets=(16,),
+                          max_concurrency=4, prefill_batch=4)
+    rng = np.random.default_rng(15)
+    requests = mixed_requests(rng, [(5, 3), (6, 2), (4, 4), (5, 2)])
+    for r in requests:
+        engine.submit(r)
+    engine.step()
+    # all four admitted at once: 4 x bucket(16) = 64 padded positions
+    # against a 32-position pool — bucket choice did not charge memory
+    assert len(engine.running_requests) + engine.stats.finished == 4
+    assert engine.stats.queue_stalls == 0
+    engine.run()
+    for r in requests:
+        np.testing.assert_array_equal(r.output(), reference(fwd, r))
+    # slot-mode contrast: the same bucket set hard-caps concurrency at
+    # the slot count regardless of true prompt lengths
+    slot = ServingEngine(layer_cfgs, params, num_slots=2, max_len=32,
+                         buckets=(16,), prefill_batch=4)
+    for r in mixed_requests(rng, [(5, 3), (6, 2), (4, 4), (5, 2)]):
+        slot.submit(r)
+    slot.step()
+    assert len(slot.running_requests) + slot.stats.finished <= 2
+
+
+def test_paged_default_span_clamps_to_position_table(gpt):
+    """The derived max_pages_per_request never rounds the per-request
+    span past max_position_embeddings: a (max_len, page_size) pair the
+    slot layout accepts must not be rejected by its own rounding."""
+    layer_cfgs, params, _ = gpt  # max_position_embeddings = 64
+    engine = ServingEngine(
+        layer_cfgs, params, num_slots=2, max_len=60, buckets=(8,),
+        kv_layout="paged", page_size=24,
+    )
+    # ceil(60/24)=3 pages would span 72 > 64; clamped to 2 pages = 48
+    assert engine.max_pages_per_request == 2 and engine.max_len == 48
+    # an EXPLICIT over-span still errors (the caller asked for it)
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        ServingEngine(layer_cfgs, params, num_slots=2, max_len=60,
+                      buckets=(8,), kv_layout="paged", page_size=24,
+                      max_pages_per_request=3)
+
+
+def test_paged_reconfigure_verify_then_apply(gpt):
+    """Paged knob classes: bucket-only changes are eviction-free; a
+    concurrency change evicts recomputation-style on the same pool; a
+    geometry change rebuilds pool+slabs with counters banked (never
+    backwards); slot engines reject page knobs; infeasible points are
+    rejected with the engine untouched."""
+    layer_cfgs, params, fwd = gpt
+    engine = paged_engine(layer_cfgs, params, max_concurrency=4)
+    rng = np.random.default_rng(16)
+    requests = mixed_requests(rng, [(5, 8), (3, 6), (6, 9)])
+    for r in requests:
+        engine.submit(r)
+    for _ in range(3):
+        engine.step()
+    engine.reconfigure(buckets=(8, 16, 32))
+    assert engine.stats.preemptions == 0  # bucket-only: no eviction
+    engine.reconfigure(max_concurrency=6)
+    assert engine.stats.preemptions > 0
+    assert engine.num_slots == 6  # rows are the paged 'slots'
+    engine.step()
+    hits_before = engine.stats.prefix_hits
+    old_pool = engine._pool
+    engine.reconfigure(num_pages=12)
+    assert engine._pool is not old_pool and engine.num_pages == 12
+    engine.run()
+    for r in requests:
+        np.testing.assert_array_equal(r.output(), reference(fwd, r))
+    assert engine.stats.snapshot()["prefix_hits"] >= hits_before
+    engine._pool.check_consistency()
+    # rejection (knob verifier) leaves the engine untouched
+    from skycomputing_tpu.analysis.plan_check import PlanError
+
+    with pytest.raises(PlanError, match="max_pages_per_request"):
+        engine.reconfigure(max_pages_per_request=100)
+    assert engine.num_pages == 12
+    # slot engines reject page knobs outright
+    slot = ServingEngine(layer_cfgs, params, num_slots=2, max_len=32,
+                         buckets=(8,))
+    with pytest.raises(ValueError, match="kv_layout='paged'"):
+        slot.reconfigure(num_pages=8)
+
+
+@pytest.mark.slow
+def test_bench_serving_paged_smoke(tmp_path):
+    """`bench_serving --paged --smoke` completes with every gate green:
+    >2x sustained concurrency at equal pool MB, zero steady-state
+    recompiles, paged/slot/one-shot token identity, and prefix-cache
+    hits counted on the shared-prompt workload."""
+    out = tmp_path / "BENCH_paged.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.bench_serving", "--paged",
+         "--smoke", "--out", str(out)],
+        capture_output=True, text=True, timeout=560,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    import json
+
+    report = json.loads(out.read_text())
+    paged = report["paged"]
+    assert paged["gates"]["concurrency_gain_over_2x"]
+    assert paged["gates"]["paged_token_identical"]
+    assert paged["gates"]["zero_steady_state_recompiles"]
+    assert paged["gates"]["prefix_hits_counted"]
+    assert paged["concurrency_gain"] > 2.0
+    assert (paged["operating_point"]["pool_positions"]
+            == paged["operating_point"]["num_pages"]
+            * paged["operating_point"]["page_size"])
 
 
 @pytest.mark.perf

@@ -1,24 +1,30 @@
 #!/usr/bin/env python
-"""Continuous vs static batching on the CPU-fallback GPT instance.
+"""Serving benchmarks on the CPU-fallback GPT instance.
 
-Evidence artifact for the serving subsystem: drives the SAME
-``ServingEngine`` kernels under two scheduling policies —
+Evidence artifact for the serving subsystem, three comparisons:
 
-- **continuous** (the engine's default): requests join/leave the
-  running batch between decode iterations (Orca-style);
-- **static** (``static_batching=True``): the naive baseline — requests
-  join only when the running batch has fully drained, so every member
-  waits for the slowest.
-
-Same kernels + greedy decoding mean both policies are token-identical
-(checked request by request), so the measured gap is purely the
-scheduling policy: continuous batching keeps KV slots occupied while
-static batching drains them.  Emits ``BENCH_serving.json``.
+- **continuous vs static batching** (the PR 4 scheduling result):
+  drives the SAME ``ServingEngine`` kernels under both policies, so
+  the measured gap is purely iteration-level scheduling; both stay
+  token-identical to one-shot ``generate``.
+- **paged vs slot KV at EQUAL pool MB** (full run / ``--paged``): the
+  slot layout charges one ``max_len`` row per request, so concurrency
+  is hard-capped at ``slots``; the paged layout charges
+  ``ceil(len/page_size)`` pages, so the same bytes hold several times
+  as many live requests.  Both engines see the identical backlog and
+  the artifact gates ``sustained_concurrency`` (mean live requests
+  while a backlog exists) at **> 2x**, with zero steady-state
+  recompiles and every paged output token-identical to ``generate``.
+- **shared-system-prompt TTFT** (radix prefix cache): after one cold
+  request, same-prefix requests prefill only their tail, so TTFT
+  drops roughly with the shared-prefix length; gated at <= 0.7x cold
+  with ``prefix_hits`` counted.
 
 Usage::
 
-    python -m tools.bench_serving                # full CPU-fallback run
+    python -m tools.bench_serving                # full run, all sections
     python -m tools.bench_serving --smoke        # seconds-scale CI probe
+    python -m tools.bench_serving --paged        # paged sections only
     python -m tools.bench_serving --out path.json --stages 2
 """
 
@@ -97,10 +103,152 @@ def run_mode(layer_cfgs, params, specs, static, smoke_cfg):
     }, {r.request_id: outputs[r.request_id] for r in requests}, requests
 
 
+def run_concurrency_mode(layer_cfgs, params, specs, paged, pcfg):
+    """One sustained-concurrency run: submit the whole backlog, step to
+    drain, sample live-request counts.  ``sustained_concurrency`` is
+    the mean of samples taken while a backlog still existed (the
+    engine was saturated — exactly when capacity, not arrival rate,
+    bounds concurrency)."""
+    from skycomputing_tpu.serving import Request, ServingEngine
+
+    kw = dict(
+        num_slots=pcfg["slots"], max_len=pcfg["max_len"],
+        buckets=pcfg["buckets"], prefill_batch=pcfg["prefill_batch"],
+        partition=pcfg["partition"],
+    )
+    if paged:
+        kw.update(
+            kv_layout="paged", page_size=pcfg["page_size"],
+            num_pages=pcfg["num_pages"],
+            max_pages_per_request=pcfg["max_pages_per_request"],
+            max_concurrency=pcfg["max_concurrency"],
+        )
+    engine = ServingEngine(layer_cfgs, params, **kw)
+    # warmup: one request per bucket (compiles every prefill shape +
+    # decode), plus a shared-prefix pair so the paged COW/copy program
+    # is warm before the measured window
+    warm_sys = np.arange(1, pcfg["page_size"] + 5, dtype=np.int32) if paged \
+        else np.arange(1, 6, dtype=np.int32)
+    # distinct leading tokens per bucket: with the prefix cache live, an
+    # arange-style warm set would let the larger bucket's prompt HIT the
+    # smaller's registered prefix and prefill only a small-bucket tail —
+    # leaving the large-bucket program cold for the measured window
+    warm = [
+        Request(prompt=np.full((b,), b + 1, np.int32),
+                max_new_tokens=2)
+        for b in pcfg["buckets"]
+    ]
+    engine.run(warm)
+    if paged:
+        # sequentially, so the second request actually HITS the first's
+        # registered prefix and compiles the COW copy + tail-bucket
+        # programs before the measured window
+        engine.run([Request(
+            prompt=np.concatenate([warm_sys, np.array([7], np.int32)]),
+            max_new_tokens=2)])
+        engine.run([Request(
+            prompt=np.concatenate([warm_sys, np.array([9], np.int32)]),
+            max_new_tokens=2)])
+
+    requests = [Request(prompt=p, max_new_tokens=n) for p, n in specs]
+    compiles0 = engine.stats.compiles
+    for r in requests:
+        engine.submit(r)
+    samples = []
+    t0 = time.perf_counter()
+    while engine.has_work():
+        backlog = len(engine.queued_requests) > 0
+        engine.step()
+        samples.append((len(engine.running_requests), backlog))
+    wall_s = time.perf_counter() - t0
+    loaded = [r for r, b in samples if b]
+    sustained = sum(loaded) / len(loaded) if loaded else 0.0
+    snap = engine.stats.snapshot()
+    pool_mb = (
+        pcfg["pool_positions"] * pcfg["kv_mb_per_position"]
+    )
+    return {
+        "layout": "paged" if paged else "slot",
+        "wall_s": wall_s,
+        "sustained_concurrency": sustained,
+        "peak_concurrency": max((r for r, _ in samples), default=0),
+        "steady_state_compiles": snap["compiles"] - compiles0,
+        "pool_mb_per_stage_layer": pool_mb,
+        "stats": snap,
+    }, {r.request_id: r.output() for r in requests}, requests
+
+
+def run_shared_prefix(layer_cfgs, params, pcfg, n_warm=4):
+    """Sequential same-system-prompt requests on a fresh paged engine:
+    request 0 is the cold prefill, requests 1..n hit the radix cache
+    and prefill only their tails — TTFT drops roughly with the shared
+    prefix length."""
+    from skycomputing_tpu.serving import Request, ServingEngine
+
+    engine = ServingEngine(
+        layer_cfgs, params,
+        num_slots=pcfg["slots"], max_len=pcfg["max_len"],
+        buckets=pcfg["buckets"], prefill_batch=pcfg["prefill_batch"],
+        partition=pcfg["partition"],
+        kv_layout="paged", page_size=pcfg["page_size"],
+        num_pages=pcfg["num_pages"],
+        max_pages_per_request=pcfg["max_pages_per_request"],
+        max_concurrency=pcfg["max_concurrency"],
+    )
+    rng = np.random.default_rng(17)
+    # warm every bucket AND the COW/prefix path with a throwaway prefix
+    shared_len = pcfg["shared_prefix_len"]
+    tail_len = pcfg["shared_tail_len"]
+    warm_sys = rng.integers(1, 400, (shared_len,)).astype(np.int32)
+    engine.run([
+        # distinct leading tokens per bucket (see run_concurrency_mode)
+        Request(prompt=np.full((b,), b + 1, np.int32),
+                max_new_tokens=2)
+        for b in pcfg["buckets"]
+    ])
+    for _ in range(2):  # sequential: the 2nd hit warms the COW path
+        engine.run([Request(prompt=np.concatenate(
+            [warm_sys, rng.integers(1, 400, (tail_len,)).astype(np.int32)]),
+            max_new_tokens=2)])
+
+    warm_snap = engine.stats.snapshot()
+    hits0 = warm_snap["prefix_hits"]
+    reused0 = warm_snap["prefix_tokens_reused"]
+    cow0 = warm_snap["cow_copies"]
+    system = rng.integers(1, 400, (shared_len,)).astype(np.int32)
+    ttfts = []
+    requests = []
+    for _ in range(1 + n_warm):
+        tail = rng.integers(1, 400, (tail_len,)).astype(np.int32)
+        r = Request(prompt=np.concatenate([system, tail]),
+                    max_new_tokens=pcfg["shared_new_tokens"])
+        engine.run([r])
+        ttfts.append(r.ttft_s())
+        requests.append(r)
+    snap = engine.stats.snapshot()
+    cold, warm_ttfts = ttfts[0], ttfts[1:]
+    mean_warm = sum(warm_ttfts) / len(warm_ttfts)
+    return {
+        "shared_prefix_len": shared_len,
+        "tail_len": tail_len,
+        "prompt_len": shared_len + tail_len,
+        "ttft_cold_s": cold,
+        "ttft_warm_s": warm_ttfts,
+        "ttft_warm_mean_s": mean_warm,
+        "ttft_warm_over_cold": mean_warm / cold if cold else None,
+        "prefix_hits": snap["prefix_hits"] - hits0,
+        "prefix_tokens_reused": snap["prefix_tokens_reused"] - reused0,
+        "cow_copies": snap["cow_copies"] - cow0,
+    }, requests
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--smoke", action="store_true",
                         help="seconds-scale model/workload (CI probe)")
+    parser.add_argument("--paged", action="store_true",
+                        help="run ONLY the paged-vs-slot + shared-prefix "
+                             "sections (the full run includes them)")
     parser.add_argument("--out", default="BENCH_serving.json")
     parser.add_argument("--stages", type=int, default=1,
                         help="pipeline stages to split the stack over")
@@ -108,7 +256,11 @@ def main() -> int:
     args = parser.parse_args()
 
     from skycomputing_tpu.builder import build_layer_stack
-    from skycomputing_tpu.models.gpt import GptConfig, gpt_layer_configs
+    from skycomputing_tpu.models.gpt import (
+        GptConfig,
+        generate,
+        gpt_layer_configs,
+    )
 
     if args.smoke:
         cfg = GptConfig(vocab_size=512, hidden_size=64,
@@ -118,6 +270,14 @@ def main() -> int:
         bench_cfg = dict(slots=3, max_len=96, buckets=(8, 16),
                          prefill_batch=1, n_requests=6,
                          lo_new=2, hi_new=12)
+        # paged A/B at equal pool MB: 3 slots x 48 == 18 pages x 8
+        paged_cfg = dict(slots=3, max_len=48, buckets=(8, 16),
+                         prefill_batch=1, page_size=8,
+                         max_pages_per_request=6, num_pages=18,
+                         max_concurrency=10, n_requests=12,
+                         lo_new=2, hi_new=6,
+                         shared_prefix_len=12, shared_tail_len=4,
+                         shared_new_tokens=3)
     else:
         cfg = GptConfig(vocab_size=8192, hidden_size=256,
                         num_hidden_layers=8, num_attention_heads=8,
@@ -126,6 +286,14 @@ def main() -> int:
         bench_cfg = dict(slots=4, max_len=192, buckets=(16, 32, 64),
                          prefill_batch=2, n_requests=20,
                          lo_new=4, hi_new=96)
+        # paged A/B at equal pool MB: 4 slots x 192 == 48 pages x 16
+        paged_cfg = dict(slots=4, max_len=192, buckets=(16, 32, 64),
+                         prefill_batch=2, page_size=16,
+                         max_pages_per_request=12, num_pages=48,
+                         max_concurrency=16, n_requests=24,
+                         lo_new=6, hi_new=40,
+                         shared_prefix_len=48, shared_tail_len=8,
+                         shared_new_tokens=8)
 
     layer_cfgs = gpt_layer_configs(cfg, deterministic=True)
     n_layers = len(layer_cfgs)
@@ -154,30 +322,8 @@ def main() -> int:
           f"{max(len(p) for p, _ in specs)} tokens, "
           f"{sum(n for _, n in specs)} tokens to generate", flush=True)
 
-    results = {}
-    outputs = {}
-    for static in (False, True):
-        name = "static" if static else "continuous"
-        print(f"running {name} batching...", flush=True)
-        result, outs, requests = run_mode(
-            layer_cfgs, params, specs, static, bench_cfg
-        )
-        results[name] = result
-        outputs[name] = [outs[r.request_id] for r in requests]
-        print(f"  {name}: {result['wall_s']:.2f}s wall, "
-              f"{result['tokens_per_s']:.1f} tok/s, "
-              f"stalls={result['stats']['queue_stalls']}", flush=True)
-
-    identical = all(
-        np.array_equal(a, b)
-        for a, b in zip(outputs["continuous"], outputs["static"])
-    )
-    speedup = (
-        results["continuous"]["tokens_per_s"]
-        / results["static"]["tokens_per_s"]
-    )
     report = {
-        "bench": "serving_continuous_vs_static",
+        "bench": "serving",
         "smoke": bool(args.smoke),
         "device_kind": jax.devices()[0].device_kind,
         "model": {k: v for k, v in cfg.to_dict().items()},
@@ -194,16 +340,169 @@ def main() -> int:
             "new_tokens": [int(n) for _, n in specs],
             "seed": args.seed,
         },
-        "continuous": results["continuous"],
-        "static": results["static"],
-        "throughput_speedup": speedup,
-        "token_identical": bool(identical),
     }
+    ok = True
+
+    if not args.paged:
+        report["bench"] = "serving_continuous_vs_static"
+        results = {}
+        outputs = {}
+        for static in (False, True):
+            name = "static" if static else "continuous"
+            print(f"running {name} batching...", flush=True)
+            result, outs, requests = run_mode(
+                layer_cfgs, params, specs, static, bench_cfg
+            )
+            results[name] = result
+            outputs[name] = [outs[r.request_id] for r in requests]
+            print(f"  {name}: {result['wall_s']:.2f}s wall, "
+                  f"{result['tokens_per_s']:.1f} tok/s, "
+                  f"stalls={result['stats']['queue_stalls']}", flush=True)
+
+        identical = all(
+            np.array_equal(a, b)
+            for a, b in zip(outputs["continuous"], outputs["static"])
+        )
+        speedup = (
+            results["continuous"]["tokens_per_s"]
+            / results["static"]["tokens_per_s"]
+        )
+        report.update(
+            continuous=results["continuous"],
+            static=results["static"],
+            throughput_speedup=speedup,
+            token_identical=bool(identical),
+        )
+        ok = ok and identical
+        print(f"continuous/static speedup: {speedup:.2f}x, "
+              f"token_identical={identical}", flush=True)
+
+    if args.paged or not args.smoke:
+        # ---- paged vs slot at EQUAL pool MB + shared-prefix TTFT ----
+        fwd = jax.jit(lambda ids: stack.apply(params, ids))
+
+        def one_shot(r):
+            return generate(
+                fwd, r.prompt[None], max_new_tokens=r.max_new_tokens,
+                context_length=paged_cfg["max_len"],
+            )[0]
+
+        # one (k,v) pair's MB per cached position, for the equal-memory
+        # provenance stamp
+        kv_mb_per_pos = 2.0 * cfg.hidden_size * 4 / 1024.0 ** 2
+        rng_p = np.random.default_rng(args.seed + 1)
+        pspecs = build_workload(
+            rng_p, paged_cfg["n_requests"], list(paged_cfg["buckets"]),
+            paged_cfg["max_len"], paged_cfg["lo_new"],
+            paged_cfg["hi_new"],
+        )
+        pcfg = dict(paged_cfg)
+        pcfg["partition"] = partition
+        pcfg["kv_mb_per_position"] = kv_mb_per_pos
+        slot_positions = pcfg["slots"] * pcfg["max_len"]
+        paged_positions = pcfg["num_pages"] * pcfg["page_size"]
+        assert slot_positions == paged_positions, (
+            "the A/B holds pool bytes fixed; fix the operating point"
+        )
+        pcfg["pool_positions"] = slot_positions
+
+        ab = {}
+        ab_outputs = {}
+        for paged in (False, True):
+            name = "paged" if paged else "slot"
+            print(f"running {name} concurrency run...", flush=True)
+            result, outs, requests = run_concurrency_mode(
+                layer_cfgs, params, pspecs, paged, pcfg
+            )
+            ab[name] = result
+            ab_outputs[name] = (outs, requests)
+            print(f"  {name}: sustained {result['sustained_concurrency']:.2f} "
+                  f"(peak {result['peak_concurrency']}), "
+                  f"{result['wall_s']:.2f}s wall, "
+                  f"recompiles={result['steady_state_compiles']}",
+                  flush=True)
+
+        paged_outs, paged_reqs = ab_outputs["paged"]
+        slot_outs, slot_reqs = ab_outputs["slot"]
+        paged_identical = all(
+            np.array_equal(paged_outs[r.request_id], one_shot(r))
+            for r in paged_reqs
+        )
+        slot_vs_paged = all(
+            np.array_equal(
+                paged_outs[pr.request_id], slot_outs[sr.request_id]
+            )
+            for pr, sr in zip(paged_reqs, slot_reqs)
+        )
+        gain = (
+            ab["paged"]["sustained_concurrency"]
+            / max(ab["slot"]["sustained_concurrency"], 1e-9)
+        )
+
+        print("running shared-prefix TTFT run...", flush=True)
+        shared, shared_reqs = run_shared_prefix(layer_cfgs, params, pcfg)
+        shared_identical = all(
+            np.array_equal(r.output(), one_shot(r)) for r in shared_reqs
+        )
+        print(f"  shared prefix {shared['shared_prefix_len']} tokens: "
+              f"cold TTFT {shared['ttft_cold_s']:.3f}s, warm mean "
+              f"{shared['ttft_warm_mean_s']:.3f}s "
+              f"({shared['ttft_warm_over_cold']:.2f}x), "
+              f"hits={shared['prefix_hits']}", flush=True)
+
+        gates = {
+            "equal_pool_mb": True,  # asserted above
+            "concurrency_gain_over_2x": bool(gain > 2.0),
+            "paged_token_identical": bool(paged_identical),
+            "paged_matches_slot": bool(slot_vs_paged),
+            "zero_steady_state_recompiles": (
+                ab["paged"]["steady_state_compiles"] == 0
+            ),
+            "prefix_hits_counted": bool(shared["prefix_hits"] >= 1),
+            "prefix_tokens_reused": bool(
+                shared["prefix_tokens_reused"]
+                >= shared["prefix_hits"] * shared["shared_prefix_len"]
+            ),
+            "shared_token_identical": bool(shared_identical),
+        }
+        if not args.smoke:
+            # a timing gate needs prefill times that dwarf scheduler
+            # noise — the smoke model prefills in ~1 ms, so the ratio
+            # is only meaningful on the full CPU-fallback instance
+            gates["shared_prefix_ttft_drops"] = bool(
+                shared["ttft_warm_over_cold"] is not None
+                and shared["ttft_warm_over_cold"] <= 0.7
+            )
+        report["paged"] = {
+            "operating_point": {
+                "page_size": pcfg["page_size"],
+                "num_pages": pcfg["num_pages"],
+                "max_pages_per_request": pcfg["max_pages_per_request"],
+                "max_concurrency": pcfg["max_concurrency"],
+                "pool_positions": pcfg["pool_positions"],
+                "pool_mb_per_stage_layer": (
+                    pcfg["pool_positions"] * kv_mb_per_pos
+                ),
+            },
+            "workload": {
+                "requests": len(pspecs),
+                "prompt_lengths": [int(len(p)) for p, _ in pspecs],
+                "new_tokens": [int(n) for _, n in pspecs],
+            },
+            "slot": ab["slot"],
+            "paged": ab["paged"],
+            "concurrency_gain": gain,
+            "shared_prefix": shared,
+            "gates": gates,
+        }
+        ok = ok and all(gates.values())
+        print(f"paged concurrency gain: {gain:.2f}x at equal pool MB; "
+              f"gates: {gates}", flush=True)
+
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
-    print(f"continuous/static speedup: {speedup:.2f}x, "
-          f"token_identical={identical} -> {args.out}", flush=True)
-    return 0 if identical else 1
+    print(f"-> {args.out} ({'PASS' if ok else 'FAIL'})", flush=True)
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":
