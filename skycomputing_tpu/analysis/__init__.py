@@ -17,8 +17,21 @@ into compile errors):
   donation-aliasing validity, plus schema validation of the elastic
   re-form ``realloc.json`` payload.  Wired into ``Runner`` startup,
   ``bench.py``, and the ``ElasticSupervisor`` re-form path.
+- :mod:`.audit` — **skyaudit**, the whole-program architecture &
+  concurrency audit: the declarative layering/purity ``MANIFEST``
+  (which layer may import which, which modules are stdlib-only by
+  contract, forbidden transitive reaches) enforced over the module
+  import graph with cycle detection, the lock-discipline rule family
+  SKY009-SKY011, and counter-type-drift checks over every
+  ``FIELD_TYPES`` classification.  CLI: ``python -m tools.skyaudit``.
 """
 
+from .audit import (
+    MANIFEST as AUDIT_MANIFEST,
+    AuditConfig,
+    RULES as AUDIT_RULES,
+    audit_paths,
+)
 from .lint import Finding, LintConfig, lint_file, lint_paths, RULES
 from .plan_check import (
     PlanError,
@@ -32,6 +45,10 @@ from .plan_check import (
 )
 
 __all__ = [
+    "AUDIT_MANIFEST",
+    "AUDIT_RULES",
+    "AuditConfig",
+    "audit_paths",
     "Finding",
     "LintConfig",
     "lint_file",

@@ -1,0 +1,1463 @@
+"""skyaudit: whole-program architecture & concurrency audit.
+
+skylint (``analysis/lint.py``) checks one file at a time for JAX
+hazards; the invariants that actually hold this repo together are
+CROSS-file, and until now nothing checked them statically:
+
+- **layering & purity** — which subpackage may import which, which
+  modules are stdlib-only by contract (file-path loadable on a bare CI
+  runner), and which reaches are forbidden outright (``dynamics`` must
+  never pull in ``fleet``; the telemetry core must never import jax).
+  Declared once in :data:`MANIFEST`, enforced over the module import
+  graph (top-level unguarded imports) with cycle detection and precise
+  module -> offending-import diagnostics.
+- **lock discipline** — the exact shape of the two races human review
+  caught after PR 8 (exporter handler threads iterating live dicts,
+  tracer lane leasing): rules SKY009-SKY011 below.
+- **counter-type drift** — the hand-maintained ``FIELD_TYPES`` counter/
+  gauge classification that the Prometheus exporter's ``# TYPE`` lines
+  and the time-series reset-safe rate math trust blindly, cross-checked
+  against the fields the classes actually produce.
+
+Rule catalog (stable IDs, one fix-it each):
+
+    AUD001  layering violation (import edge the manifest does not allow,
+            or a module no layer claims)
+    AUD002  purity violation (a stdlib-only-by-contract module or a
+            file-path-loadable tool imports outside the stdlib)
+    AUD003  import cycle (module-granular SCC in the top-level graph)
+    AUD004  forbidden transitive reach (with the offending import chain)
+    AUD005  unclassified stats field (produced by a class/snapshot bound
+            to a FIELD_TYPES contract but absent from it)
+    AUD006  plain ``=`` write to a declared counter outside ``__init__``
+            / a manifest-documented bank-and-carry site
+    SKY009  instance attribute written from a thread/handler context AND
+            from normal code without the owning lock
+    SKY010  lock-guarded attribute mutated outside any ``with`` on that
+            lock
+    SKY011  unlocked iteration over a shared dict/deque/list attribute
+            of a class that spawns threads
+
+Suppression mirrors skylint: ``# skyaudit: disable=AUD001`` on the
+finding's line, ``# skyaudit: disable-file=SKY009`` for a whole file.
+The gate (``python -m tools.skyaudit skycomputing_tpu/ tools/
+--strict``) ships green with ZERO suppressions — the violations it
+found while being built were fixed, not silenced.
+
+Scope notes (documented, deliberate): only TOP-LEVEL UNGUARDED imports
+feed the graph — imports inside ``try:`` or a function body are lazy/
+optional by construction and cannot break file-path loading or create
+an import-time cycle.  The lock rules are per-class heuristics with no
+cross-object aliasing; the classes they target (thread spawners, lock
+owners) are exactly where this repo has been bitten.
+
+PURE STDLIB BY CONTRACT, same file-path-load idiom as ``lint.py`` (the
+CLI must start in milliseconds on a runner with no jax).  The Finding
+model is duplicated from ``lint.py`` rather than imported: a
+package-relative import would break standalone file-path loading (the
+``_ERRORS_KEY`` idiom).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# --------------------------------------------------------------------------
+# model (shape-compatible with analysis.lint.Finding)
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One audit finding, pinned to a file position."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    fixit: str
+    suppressed: bool = False
+
+    def format(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule} "
+            f"{self.message}  [fix: {self.fixit}]"
+        )
+
+    def to_dict(self) -> Dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "fixit": self.fixit,
+            "suppressed": self.suppressed,
+        }
+
+
+@dataclass
+class AuditConfig:
+    """Rule selection + suppression handling for one audit run."""
+
+    select: Optional[Set[str]] = None  # None = all rules
+    ignore: Set[str] = field(default_factory=set)
+    include_suppressed: bool = False
+
+
+#: rule id -> one-line description (CLI validation + docs generation)
+RULES = {
+    "AUD001": "layering violation (disallowed inter-layer import edge)",
+    "AUD002": "purity violation (stdlib-only contract module imports "
+              "outside the stdlib)",
+    "AUD003": "import cycle in the top-level module graph",
+    "AUD004": "forbidden transitive reach (manifest forbidden_reach)",
+    "AUD005": "stats field produced but missing from its FIELD_TYPES "
+              "classification",
+    "AUD006": "plain = write to a declared counter outside __init__ / "
+              "bank-and-carry sites",
+    "SKY009": "attribute written from thread/handler context and from "
+              "normal code without the owning lock",
+    "SKY010": "lock-guarded attribute mutated outside any with on that "
+              "lock",
+    "SKY011": "unlocked iteration over a shared container attribute of "
+              "a thread-spawning class",
+}
+
+# --------------------------------------------------------------------------
+# the manifest: the repo's layering contract, declared in one place
+# --------------------------------------------------------------------------
+
+#: The architecture this audit enforces.  One entry per layer:
+#: ``modules`` are dotted-name prefixes, ``may_import`` names the layers
+#: a DIRECT top-level import edge may target (intra-layer edges are
+#: always allowed, stdlib/external imports are the purity pass's
+#: business, ``"*"`` = unconstrained).  The matrix encodes today's real
+#: graph — its value is that a NEW edge (serving importing fleet, the
+#: telemetry core importing anything) fails CI with a named diagnostic
+#: instead of shipping.  ``dynamics <-> runner`` is a known layer-level
+#: wart (faults.py provides a Hook); module-granular cycle detection
+#: (AUD003) is the hard invariant that keeps it importable.
+MANIFEST: Dict[str, Any] = {
+    "package": "skycomputing_tpu",
+    "layers": {
+        "root": {"modules": ["skycomputing_tpu"], "may_import": ["*"]},
+        "utils": {"modules": ["skycomputing_tpu.utils"],
+                  "may_import": []},
+        "registry": {"modules": ["skycomputing_tpu.registry"],
+                     "may_import": []},
+        "config": {"modules": ["skycomputing_tpu.config"],
+                   "may_import": []},
+        "stimulator": {"modules": ["skycomputing_tpu.stimulator"],
+                       "may_import": []},
+        "dataset": {"modules": ["skycomputing_tpu.dataset"],
+                    "may_import": ["registry", "utils"]},
+        "builder": {"modules": ["skycomputing_tpu.builder"],
+                    "may_import": ["registry"]},
+        "ops": {"modules": ["skycomputing_tpu.ops"],
+                "may_import": ["registry"]},
+        "models": {"modules": ["skycomputing_tpu.models"],
+                   "may_import": ["registry", "ops"]},
+        "telemetry": {"modules": ["skycomputing_tpu.telemetry"],
+                      "may_import": []},
+        "analysis": {"modules": ["skycomputing_tpu.analysis"],
+                     "may_import": ["builder"]},
+        "dynamics": {"modules": ["skycomputing_tpu.dynamics"],
+                     "may_import": ["builder", "dataset", "registry",
+                                    "runner", "stimulator", "telemetry",
+                                    "utils"]},
+        "parallel": {"modules": ["skycomputing_tpu.parallel"],
+                     "may_import": ["builder", "dynamics", "models",
+                                    "ops", "telemetry", "utils"]},
+        "serving": {"modules": ["skycomputing_tpu.serving"],
+                    "may_import": ["builder", "dynamics", "models",
+                                   "parallel", "telemetry"]},
+        "runner": {"modules": ["skycomputing_tpu.runner"],
+                   "may_import": ["dynamics", "ops", "parallel",
+                                  "registry", "telemetry", "tuning",
+                                  "utils"]},
+        "tuning": {"modules": ["skycomputing_tpu.tuning"],
+                   "may_import": ["telemetry", "utils"]},
+        "fleet": {"modules": ["skycomputing_tpu.fleet"],
+                  "may_import": ["serving", "telemetry", "utils"]},
+        "tools": {"modules": ["tools"], "may_import": ["*"]},
+    },
+    # stdlib-only by contract: loadable by FILE PATH on a bare runner
+    # (no jax, no numpy, no package-relative imports).  These are the
+    # modules the CI smoke gates load standalone.
+    "pure_stdlib": [
+        "skycomputing_tpu.analysis.audit",
+        "skycomputing_tpu.analysis.lint",
+        "skycomputing_tpu.fleet.admission",
+        "skycomputing_tpu.fleet.router",
+        "skycomputing_tpu.serving.paging",
+        "skycomputing_tpu.telemetry.analysis",
+        "skycomputing_tpu.telemetry.exporter",
+        "skycomputing_tpu.telemetry.metrics",
+        "skycomputing_tpu.telemetry.slo",
+        "skycomputing_tpu.telemetry.timeseries",
+        "skycomputing_tpu.telemetry.tracer",
+    ],
+    # CLI entry points that must START with stdlib only (their package
+    # imports live in try/except fallbacks — guarded imports are exempt)
+    "file_path_tools": [
+        "tools.bench_autotune",
+        "tools.bench_fleet",
+        "tools.changed",
+        "tools.metrics_report",
+        "tools.paging_smoke",
+        "tools.skyaudit",
+        "tools.skylint",
+        "tools.trace_report",
+    ],
+    # (source prefix, target prefix, rationale) — checked on the
+    # TRANSITIVE closure of top-level imports, chain in the diagnostic
+    "forbidden_reach": [
+        ("skycomputing_tpu.dynamics", "skycomputing_tpu.fleet",
+         "the trainer-side dynamics plane must stay deployable without "
+         "the serving fleet (faults.py talks to it duck-typed)"),
+        ("skycomputing_tpu.telemetry", "jax",
+         "the telemetry core runs on exporter handler threads and bare "
+         "CI runners — jax must never be reachable from it"),
+        ("skycomputing_tpu.telemetry", "numpy",
+         "telemetry is pure stdlib by contract; numpy breaks the "
+         "file-path-load smoke gates"),
+        ("skycomputing_tpu.serving", "skycomputing_tpu.fleet",
+         "one engine must not know about the fleet above it (the fleet "
+         "drives engines, never the reverse)"),
+    ],
+    # methods where a plain ``=`` to a declared counter is the
+    # SANCTIONED bank-and-carry idiom (a replaced sub-object's totals
+    # banked so lifetime counters never go backwards) — documented here
+    # instead of suppressed inline, so the exemption is auditable
+    "counter_bank_sites": [
+        "ServingEngine._sync_paged_stats",
+    ],
+    # snapshot-producing functions bound to a FIELD_TYPES contract they
+    # do not own: {Class.method: FIELD_TYPES-declaring class}.  Every
+    # constant key they produce must be classified there.
+    "snapshot_contracts": {
+        "EngineReplica.stats_snapshot": "EngineReplica",
+        "ServingFleet._fleet_snapshot": "FleetStats",
+    },
+}
+
+_SUPPRESS_LINE_RE = re.compile(
+    r"#\s*skyaudit:\s*disable(?:=([A-Za-z0-9_,\s]+))?"
+)
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*skyaudit:\s*disable-file=([A-Za-z0-9_,\s]+)"
+)
+
+#: module names the interpreter ships (py3.10+); the fallback set keeps
+#: the audit meaningful on exotic builds
+_STDLIB = set(getattr(sys, "stdlib_module_names", ())) or {
+    "abc", "argparse", "ast", "bisect", "collections", "contextlib",
+    "copy", "dataclasses", "enum", "functools", "hashlib", "heapq",
+    "http", "importlib", "io", "itertools", "json", "logging", "math",
+    "os", "pathlib", "queue", "random", "re", "shutil", "socket",
+    "string", "struct", "subprocess", "sys", "tempfile", "threading",
+    "time", "tokenize", "types", "typing", "unittest", "uuid",
+    "warnings", "weakref",
+}
+
+
+def _is_stdlib(name: str) -> bool:
+    return name.split(".", 1)[0] in _STDLIB or name == "__future__"
+
+
+# --------------------------------------------------------------------------
+# module discovery + import extraction
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class ImportEdge:
+    """One import statement: resolved dotted target + position."""
+
+    target: str
+    line: int
+    col: int
+    guarded: bool  # inside try/except or `if TYPE_CHECKING:`
+    lazy: bool     # inside a function/class body (not module level)
+
+
+@dataclass
+class ModuleInfo:
+    name: str
+    path: str
+    tree: Optional[ast.Module]
+    lines: List[str]
+    imports: List[ImportEdge] = field(default_factory=list)
+    parse_error: Optional[str] = None
+
+    def top_level(self) -> List[ImportEdge]:
+        """Unguarded module-level imports — the edges that fire at
+        import time and therefore feed layering/cycle/reach checks."""
+        return [e for e in self.imports if not e.guarded and not e.lazy]
+
+
+def _module_name(path: str) -> str:
+    """Dotted module name for a file, anchored at the outermost
+    directory that is still a package (has ``__init__.py``) — so
+    ``skycomputing_tpu/fleet/router.py`` names itself identically no
+    matter which directory the CLI was launched from."""
+    path = os.path.abspath(path)
+    parts = [os.path.splitext(os.path.basename(path))[0]]
+    parent = os.path.dirname(path)
+    while parent and os.path.exists(os.path.join(parent, "__init__.py")):
+        parts.insert(0, os.path.basename(parent))
+        parent = os.path.dirname(parent)
+    if parts[-1] == "__init__":
+        parts.pop()
+    return ".".join(parts) if parts else os.path.basename(path)
+
+
+def _extract_imports(info: ModuleInfo) -> None:
+    """Fill ``info.imports``, classifying guarded/lazy context."""
+    assert info.tree is not None
+    is_pkg = info.path.endswith("__init__.py")
+
+    def resolve_from(node: ast.ImportFrom) -> str:
+        if not node.level:
+            return node.module or ""
+        parts = info.name.split(".")
+        # for a package __init__, level 1 is the package itself
+        keep = len(parts) - node.level + (1 if is_pkg else 0)
+        base = parts[:max(keep, 0)]
+        return ".".join(base + ([node.module] if node.module else []))
+
+    def visit(nodes: Iterable[ast.stmt], guarded: bool,
+              lazy: bool) -> None:
+        for node in nodes:
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    info.imports.append(ImportEdge(
+                        alias.name, node.lineno, node.col_offset,
+                        guarded, lazy))
+            elif isinstance(node, ast.ImportFrom):
+                base = resolve_from(node)
+                if not base:
+                    continue
+                info.imports.append(ImportEdge(
+                    base, node.lineno, node.col_offset, guarded, lazy))
+                # `from pkg import sub` may name a MODULE: record the
+                # candidate too; the graph keeps it only if it resolves
+                for alias in node.names:
+                    if alias.name != "*":
+                        info.imports.append(ImportEdge(
+                            f"{base}.{alias.name}", node.lineno,
+                            node.col_offset, guarded, lazy))
+            elif isinstance(node, ast.Try):
+                # try body + handlers are the guarded-fallback idiom;
+                # `else:` runs whenever the try SUCCEEDED, so imports
+                # there fire on plain import — not guarded
+                visit(node.body, True, lazy)
+                for h in node.handlers:
+                    visit(h.body, True, lazy)
+                visit(node.orelse, guarded, lazy)
+                visit(node.finalbody, guarded, lazy)
+            elif isinstance(node, ast.If):
+                # ONLY `if TYPE_CHECKING:` is a guard the interpreter
+                # never enters; any other conditional import executes
+                # at import time and must feed purity/layering/reach
+                test_name = _dotted(node.test) or ""
+                is_tc = test_name in ("TYPE_CHECKING",
+                                      "typing.TYPE_CHECKING")
+                visit(node.body, guarded or is_tc, lazy)
+                visit(node.orelse, guarded, lazy)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit(node.body, guarded, True)
+            elif isinstance(node, ast.ClassDef):
+                visit(node.body, guarded, lazy)
+            elif isinstance(node, (ast.With, ast.For, ast.While)):
+                visit(node.body, guarded, lazy)
+                visit(getattr(node, "orelse", []), guarded, lazy)
+
+    visit(info.tree.body, False, False)
+
+
+def load_modules(paths: Sequence[str]) -> List[ModuleInfo]:
+    """Parse every ``*.py`` under the given files/directories."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git")]
+                files += [os.path.join(root, n) for n in sorted(names)
+                          if n.endswith(".py")]
+        else:
+            files.append(p)
+    out: List[ModuleInfo] = []
+    for path in sorted(set(files)):
+        name = _module_name(path)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+        except (OSError, UnicodeDecodeError) as exc:
+            out.append(ModuleInfo(name, path, None, [],
+                                  parse_error=f"unreadable: {exc}"))
+            continue
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as exc:
+            out.append(ModuleInfo(name, path, None,
+                                  source.splitlines(),
+                                  parse_error=f"syntax error: {exc.msg} "
+                                              f"(line {exc.lineno})"))
+            continue
+        info = ModuleInfo(name, path, tree, source.splitlines())
+        _extract_imports(info)
+        out.append(info)
+    return out
+
+
+# --------------------------------------------------------------------------
+# analysis 1: layering, purity, cycles, forbidden reach
+# --------------------------------------------------------------------------
+
+
+def _layer_of(module: str, manifest: Dict[str, Any]) -> Optional[str]:
+    """Longest-prefix layer match for a dotted module name."""
+    best, best_len = None, -1
+    for layer, spec in manifest["layers"].items():
+        for prefix in spec["modules"]:
+            if module == prefix or module.startswith(prefix + "."):
+                if len(prefix) > best_len:
+                    best, best_len = layer, len(prefix)
+    return best
+
+
+def _resolve_internal(target: str,
+                      known: Dict[str, ModuleInfo]) -> Optional[str]:
+    """Map an import target onto a module in the audited set: the
+    longest known prefix (importing ``pkg.mod.attr`` touches
+    ``pkg.mod``; importing a package touches its ``__init__``)."""
+    name = target
+    while name:
+        if name in known:
+            return name
+        if "." not in name:
+            return None
+        name = name.rsplit(".", 1)[0]
+    return None
+
+
+def _graph(modules: List[ModuleInfo]) -> Dict[str, List[Tuple[str, ImportEdge]]]:
+    """module -> [(imported module, edge)] over top-level imports."""
+    known = {m.name: m for m in modules}
+    out: Dict[str, List[Tuple[str, ImportEdge]]] = {}
+    for m in modules:
+        seen: Set[str] = set()
+        edges: List[Tuple[str, ImportEdge]] = []
+        for e in m.top_level():
+            tgt = _resolve_internal(e.target, known)
+            if tgt is None or tgt == m.name or tgt in seen:
+                continue
+            seen.add(tgt)
+            edges.append((tgt, e))
+        out[m.name] = edges
+    return out
+
+
+def _check_layering(modules: List[ModuleInfo],
+                    manifest: Dict[str, Any]) -> List[Finding]:
+    out: List[Finding] = []
+    known = {m.name: m for m in modules}
+    pkg = manifest.get("package", "")
+    for m in modules:
+        if m.tree is None:
+            continue
+        layer = _layer_of(m.name, manifest)
+        if layer is None:
+            continue  # outside the manifest's world entirely
+        spec = manifest["layers"][layer]
+        # a module the bare package prefix is the only match for is a
+        # NEW subpackage no layer claims — make it declare itself
+        if layer == "root" and m.name != pkg and pkg and \
+                m.name.startswith(pkg + "."):
+            out.append(Finding(
+                "AUD001", m.path, 1, 0,
+                f"module `{m.name}` belongs to no declared layer",
+                "add its subpackage to the MANIFEST layer table "
+                "(analysis/audit.py) with an explicit may_import list",
+            ))
+            continue
+        allowed = spec["may_import"]
+        if "*" in allowed:
+            continue
+        seen_edges: Set[Tuple[str, int]] = set()
+        for e in m.top_level():
+            tgt = _resolve_internal(e.target, known)
+            tgt_layer = _layer_of(tgt if tgt else e.target, manifest)
+            if tgt_layer is None or tgt_layer == layer:
+                continue
+            # one finding per (layer edge, line): an ImportFrom
+            # contributes the base module plus per-alias candidates
+            key = (tgt_layer, e.line)
+            if key in seen_edges:
+                continue
+            seen_edges.add(key)
+            if tgt_layer not in allowed:
+                out.append(Finding(
+                    "AUD001", m.path, e.line, e.col,
+                    f"`{m.name}` (layer {layer}) imports "
+                    f"`{tgt or e.target}` (layer {tgt_layer}) — edge "
+                    f"{layer} -> {tgt_layer} is not in the manifest",
+                    f"drop the import, invert the dependency, or (if "
+                    f"the architecture really changed) add "
+                    f"{tgt_layer!r} to {layer!r}.may_import in "
+                    f"analysis/audit.py MANIFEST",
+                ))
+    return out
+
+
+def _check_purity(modules: List[ModuleInfo],
+                  manifest: Dict[str, Any]) -> List[Finding]:
+    pure = set(manifest.get("pure_stdlib", ()))
+    tools = set(manifest.get("file_path_tools", ()))
+    out: List[Finding] = []
+    for m in modules:
+        if m.tree is None or (m.name not in pure and m.name not in tools):
+            continue
+        contract = ("stdlib-only by contract" if m.name in pure
+                    else "a file-path-loadable tool")
+        for e in m.top_level():
+            if _is_stdlib(e.target):
+                continue
+            out.append(Finding(
+                "AUD002", m.path, e.line, e.col,
+                f"`{m.name}` is {contract} but imports "
+                f"`{e.target}` at module level — this breaks "
+                f"file-path loading on a bare runner",
+                "move the import behind a guarded try/except fallback "
+                "or into the function that needs it; duplicate small "
+                "constants instead of importing them (the _ERRORS_KEY "
+                "idiom)",
+            ))
+    return out
+
+
+def _check_cycles(modules: List[ModuleInfo],
+                  manifest: Dict[str, Any]) -> List[Finding]:
+    """Tarjan SCC over the top-level import graph; any component with
+    more than one module is an import-time cycle."""
+    graph = _graph(modules)
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        # iterative Tarjan: a deep package chain must not hit the
+        # recursion limit inside a lint gate
+        work = [(v, 0)]
+        while work:
+            node, pi = work.pop()
+            if pi == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            recurse = False
+            targets = [t for t, _ in graph.get(node, ())]
+            for i in range(pi, len(targets)):
+                t = targets[i]
+                if t not in index:
+                    work.append((node, i + 1))
+                    work.append((t, 0))
+                    recurse = True
+                    break
+                elif t in on_stack:
+                    low[node] = min(low[node], index[t])
+            if recurse:
+                continue
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(comp)
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+
+    for m in sorted(graph):
+        if m not in index:
+            strongconnect(m)
+
+    paths = {m.name: m.path for m in modules}
+    out: List[Finding] = []
+    for comp in sccs:
+        if len(comp) < 2:
+            continue
+        comp = sorted(comp)
+        first = comp[0]
+        # name the edge that closes the cycle for the diagnostic
+        edge_line = 1
+        for tgt, e in graph.get(first, ()):
+            if tgt in comp:
+                edge_line = e.line
+                break
+        out.append(Finding(
+            "AUD003", paths.get(first, first), edge_line, 0,
+            f"import cycle: {' -> '.join(comp + [first])} — these "
+            f"modules cannot be file-path loaded or reasoned about "
+            f"independently",
+            "break the cycle with a lazy (function-scope) import on "
+            "the weakest edge, or move the shared piece down a layer",
+        ))
+    return out
+
+
+def _check_forbidden_reach(modules: List[ModuleInfo],
+                           manifest: Dict[str, Any]) -> List[Finding]:
+    """BFS the transitive closure from each forbidden-rule source; a
+    module whose DIRECT import hits the target prefix is reported with
+    one example chain from the rule's source."""
+    graph = _graph(modules)
+    known = {m.name: m for m in modules}
+    out: List[Finding] = []
+    for src_prefix, tgt_prefix, why in manifest.get("forbidden_reach",
+                                                    ()):
+        def hits(name: str) -> bool:
+            return name == tgt_prefix or \
+                name.startswith(tgt_prefix + ".")
+
+        starts = [m.name for m in modules
+                  if m.tree is not None and
+                  (m.name == src_prefix or
+                   m.name.startswith(src_prefix + "."))]
+        reported: Set[str] = set()
+        for start in sorted(starts):
+            # BFS with parent pointers for chain reconstruction
+            parent: Dict[str, Optional[str]] = {start: None}
+            queue = [start]
+            while queue:
+                node = queue.pop(0)
+                info = known.get(node)
+                if info is None:
+                    continue
+                if hits(node):
+                    # already inside the forbidden subtree: its own
+                    # internal edges are not new crossings — only the
+                    # edge that ENTERED it is the violation
+                    continue
+                for e in info.top_level():
+                    if hits(e.target):
+                        if node in reported:
+                            continue
+                        reported.add(node)
+                        chain: List[str] = []
+                        cur: Optional[str] = node
+                        while cur is not None:
+                            chain.append(cur)
+                            cur = parent[cur]
+                        chain.reverse()
+                        arrow = " -> ".join(chain + [e.target])
+                        out.append(Finding(
+                            "AUD004", info.path, e.line, e.col,
+                            f"forbidden reach {src_prefix} -/-> "
+                            f"{tgt_prefix}: {arrow} ({why})",
+                            "make the import lazy/guarded if it is "
+                            "optional, or cut the dependency — this "
+                            "reach is forbidden by the manifest",
+                        ))
+                for tgt, _e in graph.get(node, ()):
+                    if tgt not in parent:
+                        parent[tgt] = node
+                        queue.append(tgt)
+    # one finding per offending module per rule
+    seen: Set[Tuple[str, str, int]] = set()
+    unique = []
+    for f in out:
+        key = (f.rule, f.path, f.line)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return unique
+
+
+# --------------------------------------------------------------------------
+# analysis 2: lock discipline (SKY009-SKY011)
+# --------------------------------------------------------------------------
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "Lock", "RLock",
+               "threading.Condition", "Condition"}
+_HANDLER_BASES = {"BaseHTTPRequestHandler", "BaseRequestHandler",
+                  "StreamRequestHandler", "DatagramRequestHandler"}
+_MUTATING_METHODS = {"append", "appendleft", "add", "insert", "extend",
+                     "extendleft", "update", "pop", "popleft", "popitem",
+                     "remove", "discard", "clear", "setdefault",
+                     "__setitem__", "rotate", "sort", "reverse"}
+_CONTAINER_CTORS = {"dict", "list", "set", "deque", "defaultdict",
+                    "OrderedDict", "collections.deque",
+                    "collections.defaultdict", "collections.OrderedDict"}
+_ITER_WRAPPERS = {"list", "sorted", "tuple", "set", "dict", "sum",
+                  "max", "min", "len", "frozenset", "any", "all"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _self_attr(node: ast.AST, selves: Set[str]) -> Optional[str]:
+    """``X`` when node is ``<self-or-alias>.X``, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and \
+            node.value.id in selves:
+        return node.attr
+    return None
+
+
+@dataclass
+class _AttrEvent:
+    attr: str
+    node: ast.AST
+    kind: str        # "write" | "mutate" | "iterate"
+    locked: bool     # under `with <self>.<lock>` for an owned lock
+    fn_name: str     # enclosing method name
+    threaded: bool   # thread/handler execution context
+
+
+class _ClassAudit:
+    """Per-class lock-discipline facts, AST-only (no aliasing beyond
+    the ``alias = self`` closure idiom)."""
+
+    def __init__(self, cls: ast.ClassDef, path: str):
+        self.cls = cls
+        self.path = path
+        self.locks: Set[str] = set()
+        self.containers: Set[str] = set()
+        self.spawns_threads = False
+        self.thread_targets: Set[str] = set()  # method names run on threads
+        self.handler_classes: List[ast.ClassDef] = []
+        self.events: List[_AttrEvent] = []
+        self._scan_structure()
+        self._scan_events()
+
+    # -- pass 1: locks, containers, thread spawn points ---------------------
+    def _scan_structure(self) -> None:
+        for fn in self._methods(self.cls):
+            in_init = fn.name == "__init__"
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call):
+                    ctor = _dotted(node.value.func) or ""
+                    for t in node.targets:
+                        attr = _self_attr(t, {"self"})
+                        if attr is None:
+                            continue
+                        if ctor in _LOCK_CTORS:
+                            self.locks.add(attr)
+                        elif in_init and (ctor in _CONTAINER_CTORS or
+                                          ctor.split(".")[-1] in
+                                          ("deque", "defaultdict")):
+                            self.containers.add(attr)
+                if isinstance(node, ast.Assign) and in_init and \
+                        isinstance(node.value, (ast.Dict, ast.List,
+                                                ast.Set)):
+                    for t in node.targets:
+                        attr = _self_attr(t, {"self"})
+                        if attr is not None:
+                            self.containers.add(attr)
+                if isinstance(node, ast.Call):
+                    callee = _dotted(node.func) or ""
+                    if callee.endswith("Thread") and (
+                            callee in ("threading.Thread", "Thread")):
+                        self.spawns_threads = True
+                        for kw in node.keywords:
+                            if kw.arg == "target":
+                                tgt = _self_attr(kw.value, {"self"})
+                                if tgt:
+                                    self.thread_targets.add(tgt)
+                                elif isinstance(kw.value, ast.Name):
+                                    self.thread_targets.add(kw.value.id)
+            # nested handler classes (http.server idiom): their methods
+            # run on server threads
+            for node in ast.walk(fn):
+                if isinstance(node, ast.ClassDef):
+                    bases = {b.attr if isinstance(b, ast.Attribute)
+                             else getattr(b, "id", "")
+                             for b in node.bases}
+                    if bases & _HANDLER_BASES:
+                        self.spawns_threads = True
+                        self.handler_classes.append(node)
+
+    @staticmethod
+    def _methods(cls: ast.ClassDef) -> List[ast.FunctionDef]:
+        return [n for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    # -- pass 2: attribute events with lock + thread context ----------------
+    def _scan_events(self) -> None:
+        for fn in self._methods(self.cls):
+            threaded = fn.name in self.thread_targets
+            selves = self._self_aliases(fn)
+            self._walk_fn(fn, fn.name, threaded, selves)
+            # nested defs inherit context; a nested def passed to
+            # Thread(target=...) inside this method is itself threaded
+            for node in ast.walk(fn):
+                if isinstance(node, ast.ClassDef) and \
+                        node in self.handler_classes:
+                    # inside a handler method, `self` is the HANDLER
+                    # instance — only the closure aliases (`exp =
+                    # self`) reach the outer class's attributes;
+                    # keeping bare "self" here misattributed e.g. the
+                    # idiomatic `self.close_connection = True` to the
+                    # outer class and broke the strict gate on
+                    # correct code
+                    for sub in self._methods(node):
+                        self._walk_fn(sub, f"{fn.name}.{sub.name}",
+                                      True, selves - {"self"})
+
+    def _self_aliases(self, fn: ast.AST) -> Set[str]:
+        """`exporter = self` closure aliases, plus `self` itself."""
+        selves = {"self"}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id in selves:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        selves.add(t.id)
+        return selves
+
+    def _walk_fn(self, fn: ast.AST, fn_name: str, threaded: bool,
+                 selves: Set[str]) -> None:
+        held: List[str] = []
+
+        def locked() -> bool:
+            return bool(held)
+
+        def record(attr: str, node: ast.AST, kind: str) -> None:
+            self.events.append(_AttrEvent(
+                attr, node, kind, locked(), fn_name, threaded))
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, ast.With):
+                lock_names = []
+                for item in node.items:
+                    la = _self_attr(item.context_expr, selves)
+                    if la in self.locks:
+                        lock_names.append(la)
+                held.extend(lock_names)
+                for child in node.body:
+                    visit(child)
+                for _ in lock_names:
+                    held.pop()
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node is not fn:
+                    # nested def: runs later (callback) — same thread
+                    # context assumption, separate lock scope
+                    self._walk_fn(node, f"{fn_name}.{node.name}",
+                                  threaded, selves)
+                    return
+            if isinstance(node, ast.ClassDef) and node is not fn:
+                return  # handler classes handled explicitly
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (node.targets
+                           if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    attr = _self_attr(t, selves)
+                    if attr is not None:
+                        record(attr, node, "write")
+                    # self.X[k] = v mutates container X
+                    if isinstance(t, ast.Subscript):
+                        attr = _self_attr(t.value, selves)
+                        if attr is not None:
+                            record(attr, node, "mutate")
+            if isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        attr = _self_attr(t.value, selves)
+                        if attr is not None:
+                            record(attr, node, "mutate")
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATING_METHODS:
+                attr = _self_attr(node.func.value, selves)
+                if attr is not None:
+                    record(attr, node, "mutate")
+            # iteration shapes: for x in self.X / comprehension /
+            # list(self.X) / sorted(self.X.items())
+            if isinstance(node, (ast.For, ast.comprehension)):
+                it = node.iter
+                self._record_iteration(it, selves, record)
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id in _ITER_WRAPPERS and node.args:
+                self._record_iteration(node.args[0], selves, record)
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.comprehension):
+                    self._record_iteration(child.iter, selves, record)
+                    continue
+                visit(child)
+
+        for child in ast.iter_child_nodes(fn):
+            visit(child)
+
+    def _record_iteration(self, it: ast.AST, selves: Set[str],
+                          record) -> None:
+        attr = _self_attr(it, selves)
+        if attr is None and isinstance(it, ast.Call) and \
+                isinstance(it.func, ast.Attribute) and \
+                it.func.attr in ("items", "keys", "values"):
+            attr = _self_attr(it.func.value, selves)
+        if attr is not None and attr in self.containers:
+            record(attr, it, "iterate")
+
+
+def _lock_rules(modules: List[ModuleInfo]) -> List[Finding]:
+    out: List[Finding] = []
+    for m in modules:
+        if m.tree is None:
+            continue
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            audit = _ClassAudit(node, m.path)
+            out += _rule_sky009(audit)
+            out += _rule_sky010(audit)
+            out += _rule_sky011(audit)
+    return out
+
+
+def _rule_sky009(a: _ClassAudit) -> List[Finding]:
+    """Shared write from thread context + normal code, no common lock."""
+    if not a.spawns_threads:
+        return []
+    out: List[Finding] = []
+    by_attr: Dict[str, List[_AttrEvent]] = {}
+    for e in a.events:
+        if e.kind in ("write", "mutate"):
+            by_attr.setdefault(e.attr, []).append(e)
+    for attr, events in sorted(by_attr.items()):
+        threaded = [e for e in events if e.threaded]
+        normal = [e for e in events
+                  if not e.threaded and e.fn_name != "__init__"]
+        if not threaded or not normal:
+            continue
+        unlocked = [e for e in threaded + normal if not e.locked]
+        if not unlocked:
+            continue
+        first = min(unlocked, key=lambda e: e.node.lineno)
+        out.append(Finding(
+            "SKY009", a.path, first.node.lineno,
+            getattr(first.node, "col_offset", 0),
+            f"`{a.cls.name}.{attr}` is written from a thread/handler "
+            f"context ({threaded[0].fn_name}) AND from "
+            f"{normal[0].fn_name} without a common lock — the PR 8 "
+            f"exporter-race shape",
+            "guard both writers with `with self._lock`, or confine "
+            "the attribute to one thread and publish via an immutable "
+            "snapshot",
+        ))
+    return out
+
+
+def _rule_sky010(a: _ClassAudit) -> List[Finding]:
+    """A field the class guards SOMEWHERE must be guarded EVERYWHERE."""
+    if not a.locks:
+        return []
+    guarded = {e.attr for e in a.events
+               if e.locked and e.kind in ("write", "mutate")}
+    guarded -= a.locks
+    out: List[Finding] = []
+    seen: Set[Tuple[str, int]] = set()
+    for e in a.events:
+        if e.attr not in guarded or e.locked or \
+                e.kind not in ("write", "mutate") or \
+                e.fn_name == "__init__":
+            continue
+        key = (e.attr, e.node.lineno)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(Finding(
+            "SKY010", a.path, e.node.lineno,
+            getattr(e.node, "col_offset", 0),
+            f"`{a.cls.name}.{e.attr}` is mutated in {e.fn_name} "
+            f"outside the lock that guards it elsewhere in the class",
+            "wrap the mutation in `with self._lock` (the lock that "
+            "already guards this field), or document single-thread "
+            "ownership by renaming the unlocked path",
+        ))
+    return out
+
+
+def _rule_sky011(a: _ClassAudit) -> List[Finding]:
+    """Unlocked iteration over a shared container in a thread-spawner."""
+    if not a.spawns_threads:
+        return []
+    out: List[Finding] = []
+    seen: Set[Tuple[str, int]] = set()
+    for e in a.events:
+        if e.kind != "iterate" or e.locked or e.fn_name == "__init__":
+            continue
+        key = (e.attr, e.node.lineno)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(Finding(
+            "SKY011", a.path, e.node.lineno,
+            getattr(e.node, "col_offset", 0),
+            f"`{a.cls.name}` spawns threads but iterates shared "
+            f"container `self.{e.attr}` in {e.fn_name} without a lock "
+            f"— a concurrent insert raises RuntimeError mid-scrape",
+            "take the class lock around the iteration, or snapshot "
+            "first (`list(self.X)` under the lock) and iterate the "
+            "copy",
+        ))
+    return out
+
+
+# --------------------------------------------------------------------------
+# analysis 3: counter-type drift
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class _StatsClass:
+    name: str
+    path: str
+    node: ast.ClassDef
+    field_types: Dict[str, str]
+    field_types_line: int
+    counter_literal: Optional[List[str]] = None  # literal COUNTER_FIELDS
+    counter_literal_line: int = 0
+
+
+def _literal_str_dict(node: ast.AST,
+                      classes: Dict[str, "_StatsClass"]) -> Optional[Dict[str, str]]:
+    """Evaluate a dict literal of str->str, following one level of
+    ``**Other.FIELD_TYPES`` splats into already-collected classes."""
+    if not isinstance(node, ast.Dict):
+        return None
+    out: Dict[str, str] = {}
+    for k, v in zip(node.keys, node.values):
+        if k is None:
+            dotted = _dotted(v) or ""
+            base = dotted.rsplit(".", 1)[0] if "." in dotted else ""
+            ref = classes.get(base.split(".")[-1])
+            if dotted.endswith(".FIELD_TYPES") and ref is not None:
+                out.update(ref.field_types)
+                continue
+            return None  # unresolvable splat: skip the class entirely
+        if isinstance(k, ast.Constant) and isinstance(k.value, str) and \
+                isinstance(v, ast.Constant) and isinstance(v.value, str):
+            out[k.value] = v.value
+        else:
+            return None
+    return out
+
+
+def _collect_stats_classes(modules: List[ModuleInfo]) -> Dict[str, _StatsClass]:
+    """Every class declaring a FIELD_TYPES literal, by class name.
+    Two passes so ``**Other.FIELD_TYPES`` splats resolve regardless of
+    file order."""
+    classes: Dict[str, _StatsClass] = {}
+    pending: List[Tuple[ModuleInfo, ast.ClassDef, ast.Assign]] = []
+    for m in modules:
+        if m.tree is None:
+            continue
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for stmt in node.body:
+                if isinstance(stmt, ast.Assign) and any(
+                        isinstance(t, ast.Name) and
+                        t.id == "FIELD_TYPES"
+                        for t in stmt.targets):
+                    pending.append((m, node, stmt))
+    for _ in range(2):
+        for m, cls, stmt in pending:
+            if cls.name in classes:
+                continue
+            types = _literal_str_dict(stmt.value, classes)
+            if types is not None:
+                classes[cls.name] = _StatsClass(
+                    cls.name, m.path, cls, types, stmt.lineno)
+    # literal COUNTER_FIELDS tuples (derived comprehensions are exempt)
+    for name, sc in classes.items():
+        for stmt in sc.node.body:
+            if isinstance(stmt, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "COUNTER_FIELDS"
+                    for t in stmt.targets):
+                if isinstance(stmt.value, (ast.Tuple, ast.List)):
+                    vals = [e.value for e in stmt.value.elts
+                            if isinstance(e, ast.Constant) and
+                            isinstance(e.value, str)]
+                    if len(vals) == len(stmt.value.elts):
+                        sc.counter_literal = vals
+                        sc.counter_literal_line = stmt.lineno
+    return classes
+
+
+_NUMERIC_ANNOTATIONS = {"int", "float", "bool"}
+
+
+def _produced_keys(fn: ast.AST) -> List[Tuple[str, int]]:
+    """Constant TOP-LEVEL keys a snapshot-like function produces.
+
+    Only the returned dict's own keys count — a nested value dict (a
+    per-target/per-reason label family, classified by its parent key)
+    must not have its inner keys demanded from FIELD_TYPES.  Shapes
+    recognized: ``return dict(k=...)`` / ``return {"k": ...}``,
+    ``out = dict(...)`` + ``out.update(k=...)`` + ``out["k"] = ...``
+    for a local that is later returned.
+    """
+    returned: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and \
+                isinstance(node.value, ast.Name):
+            returned.add(node.value.id)
+
+    def top_keys(value: ast.AST) -> List[Tuple[str, int]]:
+        got: List[Tuple[str, int]] = []
+        if isinstance(value, ast.Call) and \
+                (_dotted(value.func) or "").split(".")[-1] == "dict":
+            for kw in value.keywords:
+                if kw.arg:
+                    got.append((kw.arg, value.lineno))
+        elif isinstance(value, ast.Dict):
+            for k in value.keys:
+                if isinstance(k, ast.Constant) and \
+                        isinstance(k.value, str):
+                    got.append((k.value, value.lineno))
+        return got
+
+    keys: List[Tuple[str, int]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            keys += top_keys(node.value)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id in returned:
+                    keys += top_keys(node.value)
+                elif isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id in returned and \
+                        isinstance(t.slice, ast.Constant) and \
+                        isinstance(t.slice.value, str):
+                    keys.append((t.slice.value, node.lineno))
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "update" and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id in returned:
+            for kw in node.keywords:
+                if kw.arg:
+                    keys.append((kw.arg, node.lineno))
+    return keys
+
+
+def _counter_drift(modules: List[ModuleInfo],
+                   manifest: Dict[str, Any]) -> List[Finding]:
+    classes = _collect_stats_classes(modules)
+    out: List[Finding] = []
+
+    # classes whose registered source is a DIFFERENT method (declared
+    # in snapshot_contracts, e.g. EngineReplica.stats_snapshot): their
+    # plain `snapshot()` is a non-metrics view and is exempt from the
+    # default check — the contract pass below covers the real source
+    contracts = manifest.get("snapshot_contracts", {})
+    overridden = {
+        q.partition(".")[0] for q in contracts
+        if q.partition(".")[2] != "snapshot"
+    }
+
+    # (a) unclassified numeric dataclass fields + snapshot keys
+    for sc in classes.values():
+        declared = set(sc.field_types)
+        for stmt in sc.node.body:
+            if isinstance(stmt, ast.AnnAssign) and \
+                    isinstance(stmt.target, ast.Name):
+                name = stmt.target.id
+                ann = stmt.annotation
+                ann_name = (ann.id if isinstance(ann, ast.Name)
+                            else _dotted(ann) or "")
+                if name.startswith("_") or name in declared:
+                    continue
+                if ann_name in _NUMERIC_ANNOTATIONS:
+                    out.append(Finding(
+                        "AUD005", sc.path, stmt.lineno, stmt.col_offset,
+                        f"`{sc.name}.{name}` is a numeric stats field "
+                        f"but FIELD_TYPES (line {sc.field_types_line}) "
+                        f"does not classify it — the exporter emits no "
+                        f"# TYPE line and rate math treats it as a "
+                        f"gauge silently",
+                        f'add "{name}": "counter" or "gauge" to '
+                        f"{sc.name}.FIELD_TYPES",
+                    ))
+        for stmt in sc.node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and stmt.name == "snapshot" and \
+                    sc.name not in overridden:
+                for key, line in _produced_keys(stmt):
+                    if key not in declared and not key.startswith("_"):
+                        out.append(Finding(
+                            "AUD005", sc.path, line, 0,
+                            f"`{sc.name}.snapshot()` produces key "
+                            f"`{key}` that FIELD_TYPES does not "
+                            f"classify",
+                            f'add "{key}" to {sc.name}.FIELD_TYPES '
+                            f"(or prefix it with _ if it is not a "
+                            f"metric)",
+                        ))
+
+        # (b) literal COUNTER_FIELDS must equal the counter subset
+        if sc.counter_literal is not None:
+            expect = sorted(k for k, v in sc.field_types.items()
+                            if v == "counter")
+            got = sorted(sc.counter_literal)
+            if got != expect:
+                missing = sorted(set(expect) - set(got))
+                extra = sorted(set(got) - set(expect))
+                out.append(Finding(
+                    "AUD005", sc.path, sc.counter_literal_line, 0,
+                    f"`{sc.name}.COUNTER_FIELDS` drifted from "
+                    f"FIELD_TYPES (missing: {missing or '-'}, "
+                    f"extra: {extra or '-'})",
+                    "derive COUNTER_FIELDS from FIELD_TYPES instead "
+                    "of listing it by hand",
+                ))
+
+    # (c) snapshot contracts declared in the manifest
+    for qualname, types_cls in contracts.items():
+        cls_name, _, meth_name = qualname.partition(".")
+        bound = classes.get(types_cls)
+        fn_node = None
+        fn_path = None
+        for m in modules:
+            if m.tree is None:
+                continue
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.ClassDef) and \
+                        node.name == cls_name:
+                    for stmt in node.body:
+                        if isinstance(stmt, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)) and \
+                                stmt.name == meth_name:
+                            fn_node, fn_path = stmt, m.path
+        if fn_node is None or fn_path is None:
+            continue  # contract names a method outside the audited set
+        if bound is None:
+            out.append(Finding(
+                "AUD005", fn_path, fn_node.lineno, 0,
+                f"snapshot contract `{qualname}` is bound to "
+                f"`{types_cls}.FIELD_TYPES`, which the audit cannot "
+                f"find",
+                "fix the snapshot_contracts entry in the MANIFEST or "
+                "declare FIELD_TYPES on the named class",
+            ))
+            continue
+        for key, line in _produced_keys(fn_node):
+            if key not in bound.field_types and not key.startswith("_"):
+                out.append(Finding(
+                    "AUD005", fn_path, line, 0,
+                    f"`{qualname}` produces key `{key}` that its "
+                    f"declared contract `{types_cls}.FIELD_TYPES` "
+                    f"does not classify — it reaches the exporter "
+                    f"untyped",
+                    f'classify "{key}" in {types_cls}.FIELD_TYPES '
+                    f"(counter if cumulative, gauge otherwise)",
+                ))
+
+    # (d) plain `=` writes to declared counters
+    counters: Dict[str, Set[str]] = {}
+    for sc in classes.values():
+        for fname, kind in sc.field_types.items():
+            if kind == "counter":
+                counters.setdefault(fname, set()).add(sc.name)
+    bank_sites = set(manifest.get("counter_bank_sites", ()))
+    for m in modules:
+        if m.tree is None:
+            continue
+        for cls in [n for n in ast.walk(m.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            own = classes.get(cls.name)
+            for fn in [n for n in cls.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]:
+                if fn.name == "__init__":
+                    continue
+                if f"{cls.name}.{fn.name}" in bank_sites:
+                    continue
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    for t in node.targets:
+                        if not isinstance(t, ast.Attribute):
+                            continue
+                        attr = t.attr
+                        if attr not in counters:
+                            continue
+                        base = t.value
+                        is_self_field = (
+                            own is not None and
+                            isinstance(base, ast.Name) and
+                            base.id == "self" and
+                            attr in own.field_types and
+                            own.field_types[attr] == "counter"
+                        )
+                        base_attr = (
+                            base.attr if isinstance(base, ast.Attribute)
+                            else base.id if isinstance(base, ast.Name)
+                            else ""
+                        )
+                        is_stats_field = base_attr in ("stats",
+                                                       "_stats")
+                        if not (is_self_field or is_stats_field):
+                            continue
+                        owners = ", ".join(sorted(counters[attr]))
+                        out.append(Finding(
+                            "AUD006", m.path, node.lineno,
+                            node.col_offset,
+                            f"plain `=` write to declared counter "
+                            f"`{attr}` (counter in {owners}) in "
+                            f"`{cls.name}.{fn.name}` — counters must "
+                            f"only move forward (`+=`); a reset here "
+                            f"breaks time-series rate math and "
+                            f"Prometheus semantics",
+                            "use `+=`, or (for bank-and-carry totals "
+                            "across a replaced sub-object) add the "
+                            "method to MANIFEST counter_bank_sites "
+                            "with a comment explaining the carry",
+                        ))
+    return out
+
+
+# --------------------------------------------------------------------------
+# engine
+# --------------------------------------------------------------------------
+
+
+def _suppressions(source: str):
+    """Comment-token suppression maps (same contract as skylint)."""
+    import io
+    import tokenize
+
+    per_line: Dict[int, Optional[Set[str]]] = {}
+    file_level: Set[str] = set()
+    try:
+        tokens = list(tokenize.generate_tokens(
+            io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return per_line, file_level
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_FILE_RE.search(tok.string)
+        if m:
+            file_level |= {s.strip().upper()
+                           for s in m.group(1).split(",") if s.strip()}
+            continue
+        m = _SUPPRESS_LINE_RE.search(tok.string)
+        if m:
+            if m.group(1):
+                per_line[tok.start[0]] = {
+                    s.strip().upper()
+                    for s in m.group(1).split(",") if s.strip()}
+            else:
+                per_line[tok.start[0]] = None
+    return per_line, file_level
+
+
+def audit_modules(modules: List[ModuleInfo],
+                  config: Optional[AuditConfig] = None,
+                  manifest: Optional[Dict[str, Any]] = None
+                  ) -> List[Finding]:
+    """Run all three analyses over an already-loaded module set."""
+    config = config or AuditConfig()
+    manifest = manifest if manifest is not None else MANIFEST
+    findings: List[Finding] = []
+    for m in modules:
+        if m.parse_error:
+            findings.append(Finding(
+                "AUD000", m.path, 1, 0,
+                f"file cannot be audited: {m.parse_error}",
+                "fix the file — unauditable files must not pass the "
+                "gate",
+            ))
+    findings += _check_layering(modules, manifest)
+    findings += _check_purity(modules, manifest)
+    findings += _check_cycles(modules, manifest)
+    findings += _check_forbidden_reach(modules, manifest)
+    findings += _lock_rules(modules)
+    findings += _counter_drift(modules, manifest)
+
+    # rule selection
+    selected: List[Finding] = []
+    for f in findings:
+        if f.rule != "AUD000":
+            if config.select is not None and f.rule not in config.select:
+                continue
+            if f.rule in config.ignore:
+                continue
+        selected.append(f)
+
+    # suppression handling, per file
+    sup_cache: Dict[str, Tuple[Dict[int, Optional[Set[str]]], Set[str]]] = {}
+    sources = {m.path: "\n".join(m.lines) for m in modules}
+    out: List[Finding] = []
+    for f in selected:
+        if f.path not in sup_cache:
+            sup_cache[f.path] = _suppressions(sources.get(f.path, ""))
+        per_line, file_level = sup_cache[f.path]
+        sup = f.rule in file_level
+        line_sup = per_line.get(f.line, ...)
+        if line_sup is None or (line_sup is not ... and
+                                f.rule in line_sup):
+            sup = True
+        if sup:
+            if config.include_suppressed:
+                out.append(dataclasses.replace(f, suppressed=True))
+        else:
+            out.append(f)
+
+    # stable order, dedup identical (rule, path, line, message)
+    seen = set()
+    unique = []
+    for f in sorted(out, key=lambda f: (f.path, f.line, f.rule)):
+        key = (f.rule, f.path, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return unique
+
+
+def audit_paths(paths: Sequence[str],
+                config: Optional[AuditConfig] = None,
+                manifest: Optional[Dict[str, Any]] = None
+                ) -> List[Finding]:
+    """Audit files and/or directory trees (the CLI entry point)."""
+    return audit_modules(load_modules(paths), config, manifest)
+
+
+__all__ = [
+    "AuditConfig", "Finding", "ImportEdge", "MANIFEST", "ModuleInfo",
+    "RULES", "audit_modules", "audit_paths", "load_modules",
+]

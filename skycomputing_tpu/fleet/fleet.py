@@ -224,8 +224,12 @@ class ServingFleet(LiveMetricsMixin):
         self.metrics.register("fleet", self._fleet_snapshot,
                               types=FleetStats.FIELD_TYPES)
         for rep in self.replicas:
+            # the replica's OWN classification: engine fields plus the
+            # replica-level `generation` stamp — registering the bare
+            # ServingStats types left `generation` untyped on the
+            # exporter (caught by skyaudit's snapshot-contract check)
             self.metrics.register(rep.name, rep.stats_snapshot,
-                                  types=ServingStats.FIELD_TYPES)
+                                  types=type(rep).FIELD_TYPES)
         # live observability (LiveMetricsMixin: enable_timeseries /
         # start_exporter; opt-in, zero-cost until enabled) plus the
         # fleet-only leg: an online SLO monitor evaluated every tick

@@ -36,6 +36,15 @@ class ReplicaCrashed(RuntimeError):
 class EngineReplica:
     """A named :class:`ServingEngine` with health and fault surface."""
 
+    #: metric classification for :meth:`stats_snapshot`, this class's
+    #: registered fleet source: the engine's own surface plus the
+    #: replica-level ``generation`` (a version stamp, not a rate-able
+    #: counter — it only moves at re-forms and resets with the replica
+    #: object, so deriving a per-second rate from it is meaningless).
+    #: skyaudit cross-checks every key the snapshot produces against
+    #: this dict (MANIFEST snapshot_contracts).
+    FIELD_TYPES = {**ServingStats.FIELD_TYPES, "generation": "gauge"}
+
     def __init__(self, name: str,
                  build_engine: Callable[[], ServingEngine]):
         self.name = str(name)

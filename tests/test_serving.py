@@ -691,6 +691,11 @@ def test_bench_serving_paged_smoke(tmp_path):
 
 
 @pytest.mark.perf
+# slow: drives tools/bench_serving.py end to end (~6 s); the serving
+# token-identity/recompile/exhaustion contracts it exercises are all
+# pinned by dedicated tier-1 tests above (870 s budget re-tier,
+# >=15% headroom — perf-and-slow per the pytest.ini tiering contract).
+@pytest.mark.slow
 def test_bench_serving_smoke(tmp_path):
     """`bench_serving --smoke` completes, demonstrates a continuous-vs-
     static win on a mixed workload, and its artifact carries the SLO

@@ -199,6 +199,12 @@ def _run_traced_training(devices, tmp_path, hooks=()):
     return runner, trace_path
 
 
+# slow: full 2-stage training run + Perfetto-load E2E (~8 s), the
+# heaviest trace-suite test.  Tier-1 keeps the schema/nesting/ring/
+# disabled-path contracts plus the bubble-fraction and baseline-gate
+# analyses (which also run real training) — this soak rides the full
+# run (870 s budget re-tier, >=15% headroom).
+@pytest.mark.slow
 def test_training_run_produces_loadable_trace(devices, tmp_path):
     _, trace_path = _run_traced_training(devices, tmp_path)
     assert telemetry.get_tracer() is None  # hook released ownership

@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""skyaudit CLI: the repo's whole-program architecture & concurrency audit.
+
+Usage::
+
+    python -m tools.skyaudit skycomputing_tpu/ tools/ --strict
+    python -m tools.skyaudit skycomputing_tpu/ --format=json
+    python -m tools.skyaudit --changed-only          # pre-commit mode
+    python -m tools.skyaudit skycomputing_tpu/ --select=SKY009,AUD001
+
+Three analyses over the full import/AST graph (rule catalog in
+``docs/static_analysis.md``):
+
+- layering & purity: the ``MANIFEST`` in ``analysis/audit.py`` declares
+  which layer may import which, which modules are stdlib-only by
+  contract, and which reaches are forbidden outright (AUD001-AUD004);
+- lock discipline: SKY009-SKY011, the thread/handler-context races
+  human review caught after PR 8, now machine-checked;
+- counter-type drift: the FIELD_TYPES counter/gauge classification vs
+  the fields classes actually produce (AUD005-AUD006).
+
+Exit codes: 0 clean, 1 findings, 2 bad invocation — same contract as
+skylint.  ``--changed-only`` audits the whole tree but reports only
+findings in files git says changed (whole-program invariants need the
+whole graph; the filter keeps pre-commit output focused and the run
+exits instantly when nothing relevant changed).
+
+Suppression: ``# skyaudit: disable=SKY009`` on the finding's line;
+the shipped gate runs with zero suppressions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(module_name: str, *rel_path: str):
+    """File-path module load (the skylint idiom): the audit engine is
+    pure stdlib, and this gate must start in milliseconds on a runner
+    with no jax installed."""
+    spec = importlib.util.spec_from_file_location(
+        module_name, os.path.join(_ROOT, *rel_path))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[module_name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_engine = _load("skyaudit_engine", "skycomputing_tpu", "analysis",
+                "audit.py")
+AuditConfig = _engine.AuditConfig
+RULES = _engine.RULES
+audit_paths = _engine.audit_paths
+
+#: default audit scope when no paths are given (the CI gate's scope)
+DEFAULT_PATHS = ("skycomputing_tpu", "tools")
+
+
+def _parse_rule_set(spec: str, strict: bool) -> set:
+    ids = {s.strip().upper() for s in spec.split(",") if s.strip()}
+    unknown = ids - set(RULES) - {"AUD000"}
+    if unknown:
+        msg = f"unknown rule id(s): {', '.join(sorted(unknown))}"
+        if strict:
+            print(f"skyaudit: error: {msg}", file=sys.stderr)
+            raise SystemExit(2)
+        print(f"skyaudit: warning: {msg}", file=sys.stderr)
+    return ids
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="skyaudit", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files and/or directories to audit "
+                         f"(default: {' '.join(DEFAULT_PATHS)})")
+    ap.add_argument("--strict", action="store_true",
+                    help="fail on unknown rule ids; intended for CI")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--ignore", default="",
+                    help="comma-separated rule ids to skip")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also report suppressed findings (marked)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="report only findings in files git says "
+                         "changed (whole-program passes still see the "
+                         "full tree); explicit FILE args override git")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or [
+        p for p in (os.path.join(_ROOT, d) for d in DEFAULT_PATHS)
+        if os.path.exists(p)
+    ]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"skyaudit: error: no such path: {p}", file=sys.stderr)
+            return 2
+
+    changed = None
+    if args.changed_only:
+        _changed = _load("skyaudit_changed", "tools", "changed.py")
+        changed = _changed.changed_python_files(paths, cwd=_ROOT)
+        if changed is None:
+            print("skyaudit: --changed-only: git unavailable, "
+                  "auditing everything", file=sys.stderr)
+        elif not changed:
+            print("skyaudit: --changed-only: no python changes, clean",
+                  file=sys.stderr)
+            if args.format == "json":
+                print(json.dumps({"findings": [], "counts": {},
+                                  "ok": True}, indent=2))
+            return 0
+        else:
+            # the whole-program passes need the whole graph: audit the
+            # DIRECTORY scope plus the changed files themselves (an
+            # explicit file outside the scope dirs must still be
+            # audited), then filter findings to the changed set
+            dirs = [p for p in paths if os.path.isdir(p)] or [
+                p for p in (os.path.join(_ROOT, d)
+                            for d in DEFAULT_PATHS)
+                if os.path.exists(p)
+            ]
+            paths = dirs + changed
+
+    config = AuditConfig(
+        select=_parse_rule_set(args.select, args.strict)
+        if args.select else None,
+        ignore=_parse_rule_set(args.ignore, args.strict)
+        if args.ignore else set(),
+        include_suppressed=args.show_suppressed,
+    )
+    findings = audit_paths(paths, config)
+    if changed:
+        keep = {os.path.abspath(p) for p in changed}
+        # whole-graph findings (cycles, forbidden chains) anchor to one
+        # member module that may itself be unchanged — a commit that
+        # CLOSES a cycle by editing the other end must still fail, so
+        # keep any such finding whose diagnostic names a changed module
+        changed_mods = {_engine._module_name(p) for p in changed}
+
+        def relevant(f) -> bool:
+            if os.path.abspath(f.path) in keep:
+                return True
+            if f.rule in ("AUD003", "AUD004"):
+                return any(m in f.message for m in changed_mods)
+            return False
+
+        findings = [f for f in findings if relevant(f)]
+    active = [f for f in findings if not f.suppressed]
+
+    if args.format == "json":
+        counts: dict = {}
+        for f in active:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "counts": counts,
+            "ok": not active,
+        }, indent=2))
+    else:
+        for f in findings:
+            tag = " (suppressed)" if f.suppressed else ""
+            print(f.format() + tag)
+        if active:
+            print(f"skyaudit: {len(active)} finding(s) in "
+                  f"{len({f.path for f in active})} file(s)",
+                  file=sys.stderr)
+        else:
+            print("skyaudit: clean", file=sys.stderr)
+
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

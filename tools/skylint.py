@@ -66,8 +66,10 @@ def main(argv=None) -> int:
         prog="skylint", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
-    ap.add_argument("paths", nargs="+",
-                    help="files and/or directories to lint")
+    ap.add_argument("paths", nargs="*",
+                    help="files and/or directories to lint (with "
+                         "--changed-only, defaults to the repo's "
+                         "package + tools dirs)")
     ap.add_argument("--strict", action="store_true",
                     help="fail on unknown rule ids; intended for CI gates")
     ap.add_argument("--format", choices=("text", "json"), default="text")
@@ -77,12 +79,42 @@ def main(argv=None) -> int:
                     help="comma-separated rule ids to skip")
     ap.add_argument("--show-suppressed", action="store_true",
                     help="also report suppressed findings (marked)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="lint only files named on argv or reported "
+                         "changed by git (pre-commit mode, sub-second)")
     args = ap.parse_args(argv)
 
-    for p in args.paths:
+    if not args.paths and not args.changed_only:
+        ap.error("paths required unless --changed-only is given")
+    paths = args.paths or [
+        p for p in (os.path.join(_ROOT, d)
+                    for d in ("skycomputing_tpu", "tools"))
+        if os.path.exists(p)
+    ]
+    for p in paths:
         if not os.path.exists(p):
             print(f"skylint: error: no such path: {p}", file=sys.stderr)
             return 2
+
+    if args.changed_only:
+        _cspec = importlib.util.spec_from_file_location(
+            "skylint_changed", os.path.join(_ROOT, "tools", "changed.py"))
+        _changed = importlib.util.module_from_spec(_cspec)
+        sys.modules["skylint_changed"] = _changed
+        _cspec.loader.exec_module(_changed)
+        got = _changed.changed_python_files(paths, cwd=_ROOT)
+        if got is None:
+            print("skylint: --changed-only: git unavailable, linting "
+                  "everything", file=sys.stderr)
+        elif not got:
+            print("skylint: --changed-only: no python changes, clean",
+                  file=sys.stderr)
+            if args.format == "json":
+                print(json.dumps({"findings": [], "counts": {},
+                                  "ok": True}, indent=2))
+            return 0
+        else:
+            paths = got
 
     config = LintConfig(
         select=_parse_rule_set(args.select, args.strict)
@@ -91,7 +123,7 @@ def main(argv=None) -> int:
         if args.ignore else set(),
         include_suppressed=args.show_suppressed,
     )
-    findings = lint_paths(args.paths, config)
+    findings = lint_paths(paths, config)
     active = [f for f in findings if not f.suppressed]
 
     if args.format == "json":
