@@ -38,6 +38,8 @@ from .fleet import FleetSupervisor, Router, ServingFleet
 from .parallel import PipelineModel, StageRuntime
 from .runner import AutotuneHook, Hook, Runner
 from .serving import (
+    ChunkBudgetPolicy,
+    DraftModel,
     PagedKVCachePool,
     RadixPrefixIndex,
     Request,
@@ -89,6 +91,8 @@ __all__ = [
     "Hook",
     "Runner",
     "AutotuneHook",
+    "ChunkBudgetPolicy",
+    "DraftModel",
     "PagedKVCachePool",
     "RadixPrefixIndex",
     "Request",

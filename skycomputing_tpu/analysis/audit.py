@@ -211,6 +211,7 @@ MANIFEST: Dict[str, Any] = {
         "tools.bench_autotune",
         "tools.bench_fleet",
         "tools.changed",
+        "tools.chunk_smoke",
         "tools.metrics_report",
         "tools.paging_smoke",
         "tools.skyaudit",

@@ -456,15 +456,34 @@ def _verify_slices(
                 break
 
     # ---- memory fit
+    draft_mb = 0.0
+    if serving is not None and serving.get("draft_mb") is not None:
+        # the speculative draft's LM-head copy is resident on the FIRST
+        # stage (serving/speculative.py) — charge it there, so an
+        # over-budget draft is rejected abstractly like any slab
+        try:
+            draft_mb = float(serving["draft_mb"])
+            if draft_mb < 0:
+                raise ValueError
+        except (TypeError, ValueError):
+            issues.append(PlanIssue(
+                "memory", "error" if memory == "error" else "warning",
+                f"serving draft_mb must be a non-negative number "
+                f"(speculative draft's resident params), got "
+                f"{serving['draft_mb']!r}"
+            ))
+            draft_mb = 0.0
     if memory != "skip" and not any(m is None for m in mem_per_layer):
         report.checks.append("memory")
-        for s in slices:
+        for stage_k, s in enumerate(slices):
             budget = s.get("mem_budget_mb")
             need = float(sum(mem_per_layer[s["start"]:s["end"]]))
             kv_need = 0.0
             if kv_per_layer is not None:
                 kv_need = float(sum(kv_per_layer[s["start"]:s["end"]]))
                 need += kv_need
+            draft_need = draft_mb if stage_k == 0 else 0.0
+            need += draft_need
             if budget is None:
                 continue
             if need > float(budget):
@@ -476,7 +495,11 @@ def _verify_slices(
                     detail = (
                         f" (serving {_serving_label(serving)}: "
                         f"preallocated KV slabs are {kv_need:.6g} MB "
-                        f"of the need)"
+                        f"of the need"
+                        + (f", speculative draft params "
+                           f"{draft_need:.6g} MB"
+                           if draft_need else "")
+                        + ")"
                     )
                 issues.append(PlanIssue(
                     "memory", "error" if memory == "error" else "warning",
@@ -889,6 +912,36 @@ def _verify_serving_payload(serving: Any) -> List[str]:
                     f"exceeds serving.max_len {max_len} — prompts "
                     f"padded past the KV slab depth"
                 )
+    chunk = serving.get("prefill_chunk")
+    if chunk is not None:
+        if not _pos_int(chunk):
+            problems.append(
+                f"serving.prefill_chunk must be a positive int "
+                f"(chunked-prefill chunk size), got {chunk!r}"
+            )
+        elif isinstance(buckets, list):
+            ints = [b for b in buckets if _pos_int(b)]
+            if ints and chunk not in ints:
+                problems.append(
+                    f"serving.prefill_chunk {chunk} is not one of "
+                    f"serving.buckets {ints} — chunk waves must reuse "
+                    f"a bucket's compiled prefill shape"
+                )
+    sk = serving.get("spec_k")
+    if sk is not None and (
+            isinstance(sk, bool) or not isinstance(sk, int) or sk < 0):
+        problems.append(
+            f"serving.spec_k must be a non-negative int (draft tokens "
+            f"per speculative tick; 0 disables), got {sk!r}"
+        )
+    dmb = serving.get("draft_mb")
+    if dmb is not None and (
+            isinstance(dmb, bool)
+            or not isinstance(dmb, (int, float)) or dmb < 0):
+        problems.append(
+            f"serving.draft_mb must be a non-negative number "
+            f"(speculative draft's resident params MB), got {dmb!r}"
+        )
     return problems
 
 
@@ -904,6 +957,8 @@ def verify_tuning_knobs(
     num_pages: Optional[int] = None,
     page_size: Optional[int] = None,
     max_pages_per_request: Optional[int] = None,
+    prefill_chunk: Optional[int] = None,
+    spec_k: Optional[int] = None,
 ) -> PlanReport:
     """Pre-flight a *knob-level* operating-point change (no eval_shape).
 
@@ -947,6 +1002,27 @@ def verify_tuning_knobs(
         err(f"max_pages_per_request {max_pages_per_request} exceeds "
             f"num_pages {num_pages} — one request could never be "
             f"charged")
+    if prefill_chunk is not None:
+        if not _pos_int(prefill_chunk):
+            err(f"prefill_chunk must be a positive int (the chunked-"
+                f"prefill chunk size in tokens), got {prefill_chunk!r}")
+        elif buckets is not None:
+            well_formed = [b for b in buckets if _pos_int(b)]
+            if well_formed and prefill_chunk not in well_formed:
+                # chunk waves reuse the per-bucket prefill programs —
+                # an off-bucket chunk would add a compile shape and
+                # break the steady-state recompile pin
+                err(f"prefill_chunk {prefill_chunk} is not one of the "
+                    f"buckets {sorted(set(well_formed))} — chunk waves "
+                    f"must reuse a bucket's compiled prefill shape")
+    if spec_k is not None:
+        if isinstance(spec_k, bool) or not isinstance(spec_k, int) \
+                or spec_k < 0:
+            err(f"spec_k must be a non-negative int (draft tokens per "
+                f"speculative tick; 0 disables), got {spec_k!r}")
+        elif _pos_int(max_len) and spec_k + 1 > max_len:
+            err(f"spec_k {spec_k} needs a verify window of "
+                f"{spec_k + 1} positions, more than max_len {max_len}")
     if (_pos_int(page_size) and _pos_int(max_pages_per_request)
             and max_len is None):
         # the paged per-request span IS the bucket bound

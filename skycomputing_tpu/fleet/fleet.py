@@ -492,6 +492,7 @@ class ServingFleet(LiveMetricsMixin):
         replica's name and stamp the ``migrate`` marker."""
         lane = tracer.request_lane(request.request_id, lease=False)
         mark_decode = request.trace_marks.pop("decode", None)
+        mark_prefill = request.trace_marks.pop("prefill", None)
         mark_queued = request.trace_marks.pop("queued", None)
         if lane is not None:
             base = {"request": request.request_id,
@@ -501,6 +502,9 @@ class ServingFleet(LiveMetricsMixin):
                     "decode", lane, mark_decode,
                     dict(base, tokens=len(request.tokens)),
                 )
+            elif mark_prefill is not None:
+                # a chunked prefill cut short by its replica's death
+                tracer.complete("prefill", lane, mark_prefill, base)
             elif mark_queued is not None:
                 tracer.complete("queue_wait", lane, mark_queued, base)
             tracer.instant(

@@ -529,6 +529,50 @@ def attn_indices(modules) -> list:
     ]
 
 
+def draft_slice_indices(modules, draft_blocks: int) -> list:
+    """Module indices of the prefix-slice draft model for speculative
+    decoding: embeddings + the first ``draft_blocks`` attention units
+    (with everything between them) + the LM head.
+
+    The draft is a *layer-config slice that shares the target's
+    params*: because the slice is a PREFIX of the stack, the hidden
+    state entering each sliced layer is bit-identical to what the
+    target computes there, so the draft's KV cache for those layers IS
+    the target's — it can read and write the same slabs/pages, needs no
+    prefill of its own, and costs only ``draft_blocks / num_blocks`` of
+    a decode step plus one early LM-head application.  Returns the
+    index list into the full module/param lists (the serving engine
+    slices both with it); raises when the stack is not a decodable GPT
+    or ``draft_blocks`` does not leave at least one target-only
+    attention unit (a draft as deep as the target verifies nothing).
+    """
+    if not modules or not isinstance(modules[0], GptEmbeddings):
+        raise ValueError(
+            "expected a GPT stack: GptEmbeddings + GptBlock_Attn units"
+        )
+    attn = attn_indices(modules)
+    if int(draft_blocks) < 1:
+        raise ValueError(
+            f"draft_blocks must be >= 1, got {draft_blocks}"
+        )
+    if int(draft_blocks) >= len(attn):
+        raise ValueError(
+            f"draft_blocks={draft_blocks} must be smaller than the "
+            f"target's {len(attn)} attention units — a draft as deep "
+            f"as the target cannot speed anything up"
+        )
+    if not isinstance(modules[-1], GptLmHead):
+        raise ValueError(
+            "expected the stack to end in GptLmHead (the draft reuses "
+            "the target's head at the slice point)"
+        )
+    # everything up to AND INCLUDING the block that follows the last
+    # drafted attention unit's MLP — i.e. stop just before the next
+    # attention unit — then jump to the head
+    cut = attn[int(draft_blocks)]
+    return list(range(cut)) + [len(modules) - 1]
+
+
 def apply_kv_cached(modules, params_list, data, caches, index):
     """Thread one decode step through a module SLICE.
 
@@ -774,4 +818,5 @@ __all__ = [
     "apply_kv_paged",
     "attn_indices",
     "decode_modules",
+    "draft_slice_indices",
 ]

@@ -18,7 +18,12 @@ this package is the serving side the ROADMAP's north star demands:
 - :mod:`.engine` — :class:`ServingEngine`, iteration-level continuous
   batching (Orca-style: requests join/leave the running batch between
   decode steps) over pipeline stages placed by the allocator, with
-  :class:`ServingStats` SLO metrics;
+  :class:`ServingStats` SLO metrics; ``prefill_chunk=`` interleaves
+  budgeted prefill chunks with decode ticks, ``spec_k=`` layers
+  draft-model speculative decoding on the paged layout;
+- :mod:`.speculative` — :class:`DraftModel`, the prefix-slice draft
+  (shares the target's stage-0 params and page slabs) plus the greedy
+  acceptance rule;
 - :mod:`.profile` — :class:`DecodeModelBenchmarker`, the decode-step
   cost/memory profile that makes ``Allocator.serving_allocate`` produce
   serving-balanced partitions instead of reusing training costs.
@@ -50,6 +55,7 @@ from .kv_cache import (
     update_kv_cache,
 )
 from .paging import (
+    ChunkBudgetPolicy,
     PagedKVCachePool,
     RadixPrefixIndex,
     RowAllocator,
@@ -57,10 +63,13 @@ from .paging import (
     pages_for,
 )
 from .profile import DecodeModelBenchmarker
+from .speculative import DraftModel, greedy_accept_count
 
 __all__ = [
     "AdmissionQueue",
+    "ChunkBudgetPolicy",
     "DecodeModelBenchmarker",
+    "DraftModel",
     "KVCacheSpec",
     "PagedKVCachePool",
     "QueueFullError",
@@ -73,6 +82,7 @@ __all__ = [
     "SlotKVCachePool",
     "choose_preempt_mode",
     "gather_kv_pages",
+    "greedy_accept_count",
     "init_layer_caches",
     "init_paged_caches",
     "kv_mb_per_layer",

@@ -76,6 +76,14 @@ class Request:
     index: int = 0               # current sequence length in the cache
     bucket: Optional[int] = None
     preemptions: int = 0
+    # chunked-prefill watermark (paged engine, prefill_chunk set):
+    # prompt positions whose KV is already resident.  A request admitted
+    # under chunking holds its page grant and a decode row while
+    # prefilled_len < len(effective_prompt); each engine tick advances
+    # the watermark by at most one chunk, interleaved with decode ticks.
+    # 0 means "not mid-prefill" (the one-shot wave path never sets it,
+    # and preemption resets it — recomputation replays the whole tail).
+    prefilled_len: int = 0
 
     # SLO stamps (perf_counter seconds; None until reached)
     submitted_s: Optional[float] = None

@@ -63,6 +63,7 @@ from ..models.gpt import (
     apply_kv_paged,
     attn_indices,
     decode_modules,
+    draft_slice_indices,
 )
 from ..parallel.pipeline import (
     _donation_enabled,
@@ -85,10 +86,16 @@ from .kv_cache import (
     kv_spec_from_config,
 )
 from .paging import (
+    ChunkBudgetPolicy,
     PagedKVCachePool,
     RowAllocator,
     choose_preempt_mode,
     pages_for,
+)
+from .speculative import (
+    DraftModel,
+    greedy_accept_count,
+    tree_param_mb,
 )
 
 
@@ -157,6 +164,26 @@ class ServingStats:
     swap_outs: int = 0
     swap_ins: int = 0
     prefix_evictions: int = 0
+    # chunked-prefill accounting (prefill_chunk set): prefill_chunks
+    # counts chunk rows computed (one request-chunk each);
+    # chunk_stalls counts ticks where pending chunk work was deferred
+    # by the decode-protecting budget — sustained growth means prefill
+    # demand exceeds the interleave budget (raise max_chunk_rows or
+    # prefill_chunk, or accept the TTFT cost)
+    prefill_chunks: int = 0
+    chunk_stalls: int = 0
+    # speculative-decoding accounting (spec_k > 0): draft_tokens =
+    # USABLE draft proposals (capped at each row's remaining token
+    # budget — surplus drafts a row could never commit don't deflate
+    # the rate), accepted_draft_tokens committed after the target's
+    # verify forward agreed, spec_rollbacks = verify outcomes that
+    # truncated a row's watermark past written speculative KV
+    # (accepted_draft_tokens / draft_tokens is the live accept rate
+    # the speculation speedup rides on; exactly 1.0 for a perfect
+    # draft)
+    draft_tokens: int = 0
+    accepted_draft_tokens: int = 0
+    spec_rollbacks: int = 0
     # gauges
     queue_depth: int = 0
     batch_occupancy: float = 0.0
@@ -187,6 +214,10 @@ class ServingStats:
         "prefix_hits": "counter", "prefix_tokens_reused": "counter",
         "cow_copies": "counter", "swap_outs": "counter",
         "swap_ins": "counter", "prefix_evictions": "counter",
+        "prefill_chunks": "counter", "chunk_stalls": "counter",
+        "draft_tokens": "counter",
+        "accepted_draft_tokens": "counter",
+        "spec_rollbacks": "counter",
         "queue_depth": "gauge", "batch_occupancy": "gauge",
         "pages_in_use": "gauge", "free_pages": "gauge",
         "tokens_per_s": "gauge",
@@ -228,6 +259,11 @@ class ServingStats:
             swap_outs=self.swap_outs,
             swap_ins=self.swap_ins,
             prefix_evictions=self.prefix_evictions,
+            prefill_chunks=self.prefill_chunks,
+            chunk_stalls=self.chunk_stalls,
+            draft_tokens=self.draft_tokens,
+            accepted_draft_tokens=self.accepted_draft_tokens,
+            spec_rollbacks=self.spec_rollbacks,
             queue_depth=self.queue_depth,
             batch_occupancy=self.batch_occupancy,
             pages_in_use=self.pages_in_use,
@@ -491,6 +527,10 @@ class ServingEngine(LiveMetricsMixin):
         enable_prefix_cache: bool = True,
         max_prefix_entries: int = 256,
         preempt_policy: str = "auto",
+        prefill_chunk: Optional[int] = None,
+        max_chunk_rows: Optional[int] = None,
+        spec_k: int = 0,
+        draft_blocks: Optional[int] = None,
     ):
         if kv_layout not in ("slot", "paged"):
             raise ValueError(
@@ -588,6 +628,42 @@ class ServingEngine(LiveMetricsMixin):
             max_queue=self.max_queue,
         )
         self.prefill_batch = int(prefill_batch)
+        # --- chunked prefill (paged-only): pure scheduling — split the
+        # non-shared prefill tail into prefill_chunk-token chunks that
+        # ride ticks alongside the decode slab
+        self.prefill_chunk: Optional[int] = None
+        self.max_chunk_rows: Optional[int] = None
+        self._chunk_policy: Optional[ChunkBudgetPolicy] = None
+        if prefill_chunk:
+            if not self._paged:
+                raise ValueError(
+                    "prefill_chunk requires kv_layout='paged' (partial "
+                    "prefill state lives in page tables)"
+                )
+            self._set_chunking(int(prefill_chunk), max_chunk_rows)
+        elif max_chunk_rows is not None:
+            raise ValueError("max_chunk_rows requires prefill_chunk")
+        # --- speculative decoding (paged-only): a prefix-slice draft
+        # proposes spec_k tokens per tick, the target verifies all
+        # spec_k+1 positions in one batched forward
+        self.spec_k = int(spec_k)
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        self.draft_blocks = (
+            int(draft_blocks) if draft_blocks is not None else None
+        )
+        if self.spec_k > 0:
+            if not self._paged:
+                raise ValueError(
+                    "spec_k requires kv_layout='paged' (the draft "
+                    "shares the target's stage-0 page slabs)"
+                )
+            if self.draft_blocks is None:
+                raise ValueError(
+                    "spec_k > 0 requires draft_blocks (the prefix-"
+                    "slice depth of the draft model)"
+                )
+        self._draft: Optional[DraftModel] = None
         # static_batching is the NAIVE baseline policy, kept on the same
         # kernels so tools/bench_serving.py isolates the scheduling
         # policy: requests join only at batch boundaries (when the
@@ -610,6 +686,11 @@ class ServingEngine(LiveMetricsMixin):
         self.timeseries = None
         self._exporter = None
         self._running: Dict[int, Request] = {}  # request_id -> Request
+        # chunked-prefill ledger: requests holding a page grant and a
+        # decode row whose prefilled_len watermark has not reached the
+        # end of their effective prompt (insertion order = enrollment
+        # FIFO, which chunk waves honor head-first)
+        self._prefilling: Dict[int, Request] = {}
         self._finished: List[Request] = []
         # closed-loop tuning: when set (tuning.ServingAutotuner attaches
         # itself here), every step ends with an observe/decide callback —
@@ -627,6 +708,13 @@ class ServingEngine(LiveMetricsMixin):
         self._preflight = bool(preflight)
         counts, stage_devices = self._resolve_stage_plan(
             worker_manager, partition, len(modules)
+        )
+        # the draft's only RESIDENT cost: a copy of the LM-head params
+        # on stage 0's device when the head lives on another stage —
+        # computed BEFORE the pre-flight so the verifier charges it
+        self._draft_mb = (
+            tree_param_mb(list(params_list)[-1])
+            if self.spec_k > 0 and len(counts) > 1 else 0.0
         )
         if preflight and worker_manager is not None:
             # slabs allocate eagerly below, so an over-budget serving
@@ -707,19 +795,95 @@ class ServingEngine(LiveMetricsMixin):
             self.stages.append(stage)
             cursor += n
         self._last_device = self.stages[-1].device
+        if self.spec_k > 0:
+            self._draft = self._build_draft()
+            # one source of truth for the resident charge (the
+            # pre-stage estimate above used the same head params)
+            self._draft_mb = self._draft.extra_param_mb
 
     def _serving_context(self) -> Dict[str, Any]:
         """The operating point the pre-flight verifier charges."""
         if self._paged:
-            return dict(
+            ctx = dict(
                 num_pages=self.num_pages, page_size=self.page_size,
                 max_pages_per_request=self.max_pages_per_request,
                 bucket=self.bucketer.max_bucket,
             )
+            if self._draft_mb:
+                # the speculative draft's head copy is real stage-0
+                # residency — the verifier must see it
+                ctx["draft_mb"] = self._draft_mb
+            return ctx
         return dict(
             slots=self.num_slots, max_len=self.max_len,
             bucket=self.bucketer.max_bucket,
         )
+
+    def _set_chunking(self, prefill_chunk: int,
+                      max_chunk_rows: Optional[int]) -> None:
+        """Validate + install the chunked-prefill operating point.
+        ``prefill_chunk`` must be one of the prefill buckets so chunk
+        waves reuse the per-bucket prefill programs (the recompile pin
+        holds with zero new shapes)."""
+        if prefill_chunk not in self.bucketer.buckets:
+            raise ValueError(
+                f"prefill_chunk={prefill_chunk} must be one of the "
+                f"prefill buckets {list(self.bucketer.buckets)} — chunk "
+                f"waves reuse the bucket programs"
+            )
+        rows = (
+            int(max_chunk_rows) if max_chunk_rows is not None
+            else self.prefill_batch
+        )
+        policy = ChunkBudgetPolicy(
+            prefill_chunk, max_chunk_rows=rows,
+            idle_chunk_rows=max(rows, self.prefill_batch * 2),
+        )
+        self.prefill_chunk = int(prefill_chunk)
+        self.max_chunk_rows = rows
+        self._chunk_policy = policy
+
+    def _build_draft(self) -> DraftModel:
+        """Construct the prefix-slice draft on stage 0 (fallible —
+        called before any state mutates, both at construction and when
+        ``reconfigure`` enables speculation)."""
+        full_modules = [m for st in self.stages for m in st.modules]
+        idx = draft_slice_indices(full_modules, self.draft_blocks)
+        cut = idx[-2] + 1  # prefix length (idx = range(cut) + [head])
+        stage0, last = self.stages[0], self.stages[-1]
+        if cut > len(stage0.modules):
+            raise ValueError(
+                f"draft_blocks={self.draft_blocks} needs the first "
+                f"{cut} layers resident on stage 0, which holds only "
+                f"{len(stage0.modules)} — shrink the draft or deepen "
+                f"stage 0 (the draft shares stage 0's params and slabs)"
+            )
+        head_module = full_modules[-1]
+        if len(self.stages) > 1:
+            head_params = jax.device_put(last.params[-1], stage0.device)
+            extra_mb = tree_param_mb(head_params)
+        else:
+            head_params = stage0.params[-1]
+            extra_mb = 0.0
+        key = DraftModel.program_key(
+            [self._model_cfg[i] for i in idx], self.max_len
+        )
+        return DraftModel(
+            list(stage0.modules[:cut]) + [head_module],
+            list(stage0.params[:cut]) + [head_params],
+            stage0.device,
+            extra_param_mb=extra_mb,
+            program_key=key,
+        )
+
+    def _pending_draft_mb(self) -> float:
+        """The draft memory a spec-enable would ADD to stage 0 (the
+        LM-head copy; 0 when it already lives there) — computed without
+        allocating anything, so the pre-flight can charge it BEFORE
+        :meth:`_build_draft` performs the device_put."""
+        if len(self.stages) <= 1:
+            return 0.0
+        return tree_param_mb(self.stages[-1].params[-1])
 
     # --- construction helpers ----------------------------------------------
     def _resolve_stage_plan(self, worker_manager, partition, n_layers):
@@ -816,6 +980,41 @@ class ServingEngine(LiveMetricsMixin):
                 "replica": self.trace_name}
         args.update(extra)
         tracer.complete("queue_wait", lane, mark, args,
+                        dur_us=end - mark)
+
+    def _trace_enroll(self, request: Request, grant, tracer) -> None:
+        """Chunked enrollment: admission instant, queue segment closed,
+        and the request-lane ``prefill`` segment OPENED (it spans
+        enrollment -> final chunk, closed by ``_trace_close_prefill``)."""
+        if tracer is None:
+            return
+        now_us = tracer.now()
+        tracer.instant(
+            "admit", tracer.lane("serving", "engine"),
+            {"request": request.request_id, "slot": request.slot,
+             "pages": len(grant.page_table),
+             "shared": grant.shared_tokens, "chunked": True},
+        )
+        self._trace_close_queue(request, tracer, end_us=now_us)
+        request.trace_marks["prefill"] = now_us
+
+    def _trace_close_prefill(self, request: Request, tracer,
+                             end_us: Optional[float] = None,
+                             **extra) -> None:
+        """Close the open chunked ``prefill`` segment, if any."""
+        if tracer is None:
+            return
+        mark = request.trace_marks.pop("prefill", None)
+        if mark is None:
+            return
+        lane = tracer.request_lane(request.request_id, lease=False)
+        if lane is None:
+            return
+        end = tracer.now() if end_us is None else end_us
+        args = {"request": request.request_id,
+                "replica": self.trace_name}
+        args.update(extra)
+        tracer.complete("prefill", lane, mark, args,
                         dur_us=end - mark)
 
     def _trace_close_decode(self, request: Request, tracer,
@@ -936,6 +1135,10 @@ class ServingEngine(LiveMetricsMixin):
         structurally cannot serve.
         """
         request = self._running.get(request_id)
+        prefilling = False
+        if request is None:
+            request = self._prefilling.get(request_id)
+            prefilling = request is not None
         if request is None:
             raise KeyError(f"request {request_id} is not running")
         if mode not in (None, "auto", "recompute", "swap"):
@@ -945,6 +1148,13 @@ class ServingEngine(LiveMetricsMixin):
             raise ValueError(
                 f"preempt mode must be 'auto', 'recompute' or 'swap', "
                 f"got {mode!r}"
+            )
+        if prefilling and mode == "swap":
+            # a partial prefill's pages hold an incomplete prompt; a
+            # swap record would resume mid-watermark on an engine that
+            # may no longer chunk — recomputation replays it exactly
+            raise ValueError(
+                "a mid-prefill request preempts by recomputation only"
             )
         resume_len = int(request.effective_prompt.size)
         if not self._paged:
@@ -957,6 +1167,13 @@ class ServingEngine(LiveMetricsMixin):
             # any state: a request grown past the largest bucket cannot
             # resume by recomputation, and a failed preempt must leave
             # it running
+            self.bucketer.bucket_for(resume_len)
+            mode = "recompute"
+        elif prefilling:
+            # validate the resume prefix still fits a bucket (the
+            # re-queue requires one), then recompute — no tokens were
+            # generated yet, so the replay is the same admission the
+            # request already passed
             self.bucketer.bucket_for(resume_len)
             mode = "recompute"
         else:
@@ -989,7 +1206,11 @@ class ServingEngine(LiveMetricsMixin):
                 pages=len(held), index=request.index,
                 data=[st.swap_out(table) for st in self.stages],
             )
-        self._running.pop(request_id)
+        if prefilling:
+            self._prefilling.pop(request_id)
+            request.prefilled_len = 0  # recompute replays the tail
+        else:
+            self._running.pop(request_id)
         self._release_slot(request.slot)
         if self._paged:
             self._pool.release(request_id)
@@ -1008,8 +1229,10 @@ class ServingEngine(LiveMetricsMixin):
             # the request's decode segment ends here (the engine-lane
             # preempt instant above already carries the request id, so
             # the timeline keeps its marker without a duplicate that
-            # would double trace-derived preemption counts)
+            # would double trace-derived preemption counts); a
+            # mid-prefill victim closes its chunked prefill segment
             self._trace_close_decode(request, tracer, preempted=True)
+            self._trace_close_prefill(request, tracer, preempted=True)
         # force: the queue bound gates NEW admissions only — a preempted
         # request is already admitted and dropping it loses its tokens.
         # A swapped request needs no prefill bucket (its KV returns from
@@ -1040,7 +1263,7 @@ class ServingEngine(LiveMetricsMixin):
         pool), so migration resumes by re-prefilling the effective
         prompt — and any swap records held for queued requests are
         dropped with the same consequence."""
-        for request_id in list(self._running):
+        for request_id in list(self._running) + list(self._prefilling):
             try:
                 # cross-engine resume is recompute by construction
                 self.preempt(request_id, mode="recompute")
@@ -1062,8 +1285,13 @@ class ServingEngine(LiveMetricsMixin):
 
     @property
     def running_requests(self) -> List[Request]:
-        """Requests currently holding a slot (read-only view)."""
-        return list(self._running.values())
+        """Requests currently holding a slot/row (read-only view).
+        Includes chunked-prefill requests mid-watermark: they hold a
+        decode row and a page grant, so fleet slot-accounting and
+        migration must see them as live."""
+        return list(self._prefilling.values()) + list(
+            self._running.values()
+        )
 
     @property
     def queued_requests(self) -> List[Request]:
@@ -1106,13 +1334,17 @@ class ServingEngine(LiveMetricsMixin):
 
     # --- the continuous-batching loop ---------------------------------------
     def has_work(self) -> bool:
-        return bool(self._running) or self._queue.depth > 0
+        return (bool(self._running) or bool(self._prefilling)
+                or self._queue.depth > 0)
 
     def step(self) -> None:
-        """One engine iteration: admit prefill waves, then one decode
-        tick over the slot slab.  Requests join and leave the running
-        batch only here, between decode steps — iteration-level
-        scheduling."""
+        """One engine iteration: admit prefill waves (or, with
+        ``prefill_chunk`` set, enroll admissions and advance at most a
+        budgeted number of prefill chunks), then one decode tick over
+        the slot slab.  Requests join and leave the running batch only
+        here, between decode steps — iteration-level scheduling; the
+        chunk budget bounds how much prefill any single decode tick
+        can wait behind."""
         if self._queue.depth > 0 and self.free_slots == 0:
             self.stats.queue_stalls += 1
             tracer = get_tracer()
@@ -1123,7 +1355,12 @@ class ServingEngine(LiveMetricsMixin):
                 )
         if self._paged:
             self._admit_paged()
-            self._decode_tick_paged()
+            if self._chunk_policy is not None:
+                self._chunk_tick()
+            if self.spec_k > 0 and self._draft is not None:
+                self._spec_tick()
+            else:
+                self._decode_tick_paged()
         else:
             self._admit()
             self._decode_tick()
@@ -1165,6 +1402,9 @@ class ServingEngine(LiveMetricsMixin):
         page_size: Optional[int] = None,
         max_pages_per_request: Optional[int] = None,
         max_concurrency: Optional[int] = None,
+        prefill_chunk: Optional[int] = None,
+        max_chunk_rows: Optional[int] = None,
+        spec_k: Optional[int] = None,
     ) -> None:
         """Apply a new serving operating point IN PLACE, between steps.
 
@@ -1200,7 +1440,10 @@ class ServingEngine(LiveMetricsMixin):
         restarts cold (its counters banked, never reset), and host
         swap records (whose page shapes died with the geometry)
         convert to recomputation resumes only after every affected
-        request is proven to fit a prefill bucket.
+        request is proven to fit a prefill bucket.  Paged engines also
+        learn the scheduler knobs ``prefill_chunk``/``max_chunk_rows``
+        (chunked prefill) and ``spec_k`` (speculative decoding) — see
+        :meth:`_reconfigure_paged` for their enable/disable semantics.
         """
         from ..analysis.plan_check import verify_tuning_knobs
 
@@ -1211,14 +1454,18 @@ class ServingEngine(LiveMetricsMixin):
                 page_size=page_size,
                 max_pages_per_request=max_pages_per_request,
                 max_concurrency=max_concurrency,
+                prefill_chunk=prefill_chunk,
+                max_chunk_rows=max_chunk_rows, spec_k=spec_k,
             )
             return
         if any(k is not None for k in
                (num_pages, page_size, max_pages_per_request,
-                max_concurrency)):
+                max_concurrency, prefill_chunk, max_chunk_rows,
+                spec_k)):
             raise ValueError(
                 "page knobs (num_pages/page_size/max_pages_per_request/"
-                "max_concurrency) require kv_layout='paged'"
+                "max_concurrency/prefill_chunk/max_chunk_rows/spec_k) "
+                "require kv_layout='paged'"
             )
         if buckets is not None:
             # same normalization the constructor's ShapeBucketer applies,
@@ -1356,9 +1603,25 @@ class ServingEngine(LiveMetricsMixin):
         page_size=None,
         max_pages_per_request=None,
         max_concurrency=None,
+        prefill_chunk=None,
+        max_chunk_rows=None,
+        spec_k=None,
     ) -> None:
         """The paged half of :meth:`reconfigure` (same verify-then-
-        apply contract; see its docstring for the knob semantics)."""
+        apply contract; see its docstring for the knob semantics).
+
+        ``prefill_chunk`` and ``spec_k`` are the chunked-prefill and
+        speculative-decoding knobs: ``None`` keeps the current setting,
+        ``0`` disables.  Both are pure scheduling — no slab rebuild —
+        but disabling chunking evicts mid-prefill requests back to the
+        queue (recompute-style: no one would ever finish their chunks),
+        a chunk size must be a member of the (new) bucket set, and a
+        ``spec_k`` change retraces the verify program at its new
+        ``Lq = spec_k + 1`` shape on the next tick (a visible one-time
+        warmup, the same one construction pays per bucket).  Enabling
+        speculation requires the engine to have been built with
+        ``draft_blocks`` (the draft's layer slice is construction
+        state)."""
         from ..analysis.plan_check import verify_tuning_knobs
 
         if buckets is not None:
@@ -1398,12 +1661,35 @@ class ServingEngine(LiveMetricsMixin):
             isinstance(new_mpr, int) and isinstance(new_psize, int)
             and new_mpr > 0 and new_psize > 0
         ) else self.max_len
+        # chunk / speculation knobs: None keeps, 0 disables
+        new_chunk = (
+            self.prefill_chunk if prefill_chunk is None
+            else (int(prefill_chunk) or None)
+        )
+        new_chunk_rows = (
+            int(max_chunk_rows) if max_chunk_rows is not None
+            else self.max_chunk_rows
+        )
+        if max_chunk_rows is not None and new_chunk is None:
+            # mirror the constructor: a rows knob with chunking off
+            # (or being disabled here) must fail loudly, not silently
+            # drop the operator's starvation bound
+            raise ValueError("max_chunk_rows requires prefill_chunk")
+        new_spec = self.spec_k if spec_k is None else int(spec_k)
         verify_tuning_knobs(
             buckets=new_buckets, max_len=new_virtual,
             num_slots=new_rows, prefill_batch=new_batch,
             num_pages=new_pages, page_size=new_psize,
             max_pages_per_request=new_mpr,
+            prefill_chunk=new_chunk, spec_k=new_spec,
         ).raise_if_failed()
+        if new_spec > 0 and self._draft is None and (
+                self.draft_blocks is None):
+            raise ValueError(
+                "reconfigure rejected: spec_k > 0 requires an engine "
+                "built with draft_blocks (the draft's layer slice is "
+                "construction state)"
+            )
         max_pos = _gcfg(
             self.stages[0].modules[0].config
         ).max_position_embeddings
@@ -1418,9 +1704,17 @@ class ServingEngine(LiveMetricsMixin):
         )
         rows_change = new_rows != self.max_concurrency
         must_evict = geometry_change or rows_change
+        # an enable of speculation makes the draft's LM-head copy newly
+        # resident on stage 0 — that is real memory the verifier must
+        # see BEFORE _build_draft's device_put allocates it
+        enabling_spec = new_spec > 0 and self._draft is None
+        charged_draft_mb = (
+            self._pending_draft_mb() if enabling_spec else self._draft_mb
+        )
         if (self._preflight and self._worker_manager is not None
                 and (geometry_change
-                     or max(new_buckets) > self.bucketer.max_bucket)):
+                     or max(new_buckets) > self.bucketer.max_bucket
+                     or (enabling_spec and charged_draft_mb > 0))):
             # ANY geometry change pre-builds a full second slab set
             # while the old one is still resident, so the transient
             # peak is old+new pool depth even when the new pool is
@@ -1431,19 +1725,26 @@ class ServingEngine(LiveMetricsMixin):
             charged = new_pages + (
                 self.num_pages if geometry_change else 0
             )
+            ctx = dict(num_pages=charged, page_size=new_psize,
+                       max_pages_per_request=new_mpr,
+                       bucket=max(new_buckets))
+            if charged_draft_mb > 0:
+                ctx["draft_mb"] = charged_draft_mb
             verify_plan(
                 self._model_cfg, self._worker_manager,
                 (np.zeros((new_rows, 1), np.int32),),
                 memory="error", check_donation=False,
-                serving=dict(num_pages=charged, page_size=new_psize,
-                             max_pages_per_request=new_mpr,
-                             bucket=max(new_buckets)),
+                serving=ctx,
             ).raise_if_failed()
+        # (an off-bucket prefill_chunk was already rejected by
+        # verify_tuning_knobs above — the one enforcement point)
         new_bucketer = ShapeBucketer(new_buckets)
         # feasibility BEFORE any mutation.  Swap records survive only a
         # geometry-preserving change; under a geometry change every
         # swapped request must be able to resume by recomputation.
-        live = list(self._running.values()) + list(self._queue.requests)
+        live = (list(self._running.values())
+                + list(self._prefilling.values())
+                + list(self._queue.requests))
         for r in live:
             length = int(r.effective_prompt.size)
             swapped = r.request_id in self._swapped
@@ -1477,30 +1778,61 @@ class ServingEngine(LiveMetricsMixin):
             if geometry_change else None
         )
         new_row_alloc = RowAllocator(new_rows) if must_evict else None
+        # pre-build the fallible chunk/spec machinery before mutation
+        new_policy = None
+        if new_chunk is not None:
+            rows = (
+                new_chunk_rows if new_chunk_rows is not None
+                else new_batch
+            )
+            new_policy = ChunkBudgetPolicy(
+                new_chunk, max_chunk_rows=rows,
+                idle_chunk_rows=max(rows, new_batch * 2),
+            )
+        new_draft = self._draft
+        if new_spec > 0 and new_draft is None:
+            new_draft = self._build_draft()
 
         tracer = get_tracer()
         old = dict(buckets=list(self.bucketer.buckets),
                    max_concurrency=self.max_concurrency,
                    prefill_batch=self.prefill_batch,
                    num_pages=self.num_pages, page_size=self.page_size,
-                   max_pages_per_request=self.max_pages_per_request)
+                   max_pages_per_request=self.max_pages_per_request,
+                   prefill_chunk=self.prefill_chunk,
+                   spec_k=self.spec_k)
         evicted: List[Request] = []
+
+        def evict(r: Request, prefilling: bool) -> None:
+            if prefilling:
+                self._prefilling.pop(r.request_id)
+                r.prefilled_len = 0  # recompute replays the tail
+            else:
+                self._running.pop(r.request_id)
+            self._release_slot(r.slot)
+            self._pool.release(r.request_id)
+            r.slot = None
+            r.preemptions += 1
+            self.stats.preemptions += 1
+            evicted.append(r)
+            if tracer is not None:
+                tracer.instant(
+                    "preempt", tracer.lane("serving", "engine"),
+                    {"request": r.request_id, "reconfigure": True},
+                )
+                self._trace_close_decode(r, tracer, reconfigure=True)
+                self._trace_close_prefill(r, tracer, reconfigure=True)
+
         if must_evict:
             for r in list(self._running.values()):
-                self._running.pop(r.request_id)
-                self._release_slot(r.slot)
-                self._pool.release(r.request_id)
-                r.slot = None
-                r.preemptions += 1
-                self.stats.preemptions += 1
-                evicted.append(r)
-                if tracer is not None:
-                    tracer.instant(
-                        "preempt", tracer.lane("serving", "engine"),
-                        {"request": r.request_id, "reconfigure": True},
-                    )
-                    self._trace_close_decode(r, tracer,
-                                             reconfigure=True)
+                evict(r, prefilling=False)
+            for r in list(self._prefilling.values()):
+                evict(r, prefilling=True)
+        elif new_chunk is None and self._prefilling:
+            # chunking turned off with requests mid-watermark: no chunk
+            # tick would ever finish them — re-queue recompute-style
+            for r in list(self._prefilling.values()):
+                evict(r, prefilling=True)
         queued = self._queue.drain()
         if tracer is not None:
             for r in queued:
@@ -1535,6 +1867,15 @@ class ServingEngine(LiveMetricsMixin):
             self.num_slots = new_rows
         self.bucketer = new_bucketer
         self.prefill_batch = new_batch
+        self.prefill_chunk = new_chunk
+        self.max_chunk_rows = (
+            new_policy.max_chunk_rows if new_policy is not None else None
+        )
+        self._chunk_policy = new_policy
+        self.spec_k = new_spec
+        if new_spec > 0:
+            self._draft = new_draft
+            self._draft_mb = new_draft.extra_param_mb
         self._queue = AdmissionQueue(new_bucketer, prefill_batch=new_batch,
                                      max_queue=self.max_queue)
         for r in evicted + queued:
@@ -1554,7 +1895,9 @@ class ServingEngine(LiveMetricsMixin):
                               max_concurrency=new_rows,
                               prefill_batch=new_batch,
                               num_pages=new_pages, page_size=new_psize,
-                              max_pages_per_request=new_mpr),
+                              max_pages_per_request=new_mpr,
+                              prefill_chunk=new_chunk,
+                              spec_k=new_spec),
                      evicted=len(evicted)),
             )
 
@@ -1602,6 +1945,7 @@ class ServingEngine(LiveMetricsMixin):
                 free_pages=self._pool.free_pages,
                 pages_in_use=self._pool.pages_in_use,
                 swapped=len(self._swapped),
+                prefilling=len(self._prefilling),
             )
         return snap
 
@@ -1787,11 +2131,215 @@ class ServingEngine(LiveMetricsMixin):
                     self._stall_on_pages()
                     return
                 continue
+            if self._chunk_policy is not None:
+                # chunked admission is charge-only (no compute): the
+                # head gets its page grant and decode row, then its
+                # prefill rides budgeted chunk waves across later ticks
+                if not self._enroll_chunked(head):
+                    self._stall_on_pages()
+                    return
+                continue
             wave = self._select_paged_wave()
             if wave is None:
                 self._stall_on_pages()
                 return
             self._prefill_wave_paged(wave)
+
+    def _enroll_chunked(self, request: Request) -> bool:
+        """Admit the queue head under chunked prefill: charge its page
+        grant, seat it on a decode row, perform the grant's COW copy,
+        and set the ``prefilled_len`` watermark at the shared-prefix
+        boundary.  No prefill compute happens here — chunk waves do
+        that, budgeted per tick.  False (nothing mutated) when the
+        pages cannot be charged yet."""
+        tokens = self._effective_tokens(request)
+        grant = self._pool.acquire(
+            request.request_id, tokens, len(tokens) + request.remaining
+        )
+        if grant is None:
+            return False
+        row = self._rows.allocate()
+        assert row is not None  # caller checked free rows
+        request.slot = row
+        # COW before any chunk write: the donor's partial page becomes
+        # this request's private page (same rule as the one-shot wave)
+        if grant.cow_src is not None:
+            for st in self.stages:
+                st.cow_copy(grant.cow_src, grant.cow_dst)
+        self._queue.remove(request)
+        request.prefilled_len = grant.shared_tokens
+        request.status = RUNNING
+        self._prefilling[request.request_id] = request
+        self.stats.queue_depth = self._queue.depth
+        tracer = get_tracer()
+        self._trace_enroll(request, grant, tracer)
+        return True
+
+    def _chunk_tick(self) -> None:
+        """Advance chunked prefill by at most the policy's budget:
+        head-fixes-the-bucket chunk waves (enrollment FIFO) until the
+        budget is spent, each request advancing AT MOST ONE chunk per
+        tick (fairness: the head can never eat the whole budget while
+        later enrollees starve).  A tick that leaves some mid-prefill
+        request without a chunk counts one ``chunk_stalls`` — work was
+        actually deferred, the deliberate price of protecting decode
+        latency."""
+        if not self._prefilling:
+            return
+        budget = self._chunk_policy.rows_for_tick(
+            pending=len(self._prefilling), decoding=len(self._running)
+        )
+        advanced: set = set()
+        while budget > 0:
+            wave = self._select_chunk_wave(
+                min(budget, self.prefill_batch), advanced
+            )
+            if not wave:
+                break
+            advanced.update(r.request_id for r in wave)
+            self._chunk_wave(wave)
+            budget -= len(wave)
+        # requests still mid-watermark that got NO chunk this tick:
+        # the budget (or a bucket mismatch past it) deferred real work
+        deferred = [
+            rid for rid in self._prefilling if rid not in advanced
+        ]
+        if deferred:
+            self.stats.chunk_stalls += 1
+            tracer = get_tracer()
+            if tracer is not None:
+                tracer.instant(
+                    "chunk_stall", tracer.lane("serving", "engine"),
+                    {"deferred": len(deferred)},
+                )
+
+    def _next_chunk_len(self, request: Request) -> int:
+        return min(
+            self.prefill_chunk,
+            int(request.effective_prompt.size) - request.prefilled_len,
+        )
+
+    def _select_chunk_wave(self, cap: int,
+                           exclude: set) -> List[Request]:
+        """Up to ``cap`` mid-prefill requests whose NEXT chunk pads to
+        the enrollment head's bucket (same-bucket packing, FIFO head
+        never skipped — the wave-selection rule at chunk granularity).
+        ``exclude`` holds requests already advanced this tick, so one
+        tick never gives the head a second chunk while others wait."""
+        pending = [
+            r for r in self._prefilling.values()
+            if r.request_id not in exclude
+        ]
+        if not pending:
+            return []
+        head = pending[0]
+        bucket = self.bucketer.bucket_for(self._next_chunk_len(head))
+        wave: List[Request] = []
+        for r in pending:
+            if len(wave) >= cap:
+                break
+            if self.bucketer.bucket_for(
+                    self._next_chunk_len(r)) == bucket:
+                wave.append(r)
+        return wave
+
+    def _chunk_wave(self, wave: List[Request]) -> None:
+        """One prefill-chunk wave: each member's next
+        ``<= prefill_chunk`` prompt positions, padded to the wave
+        bucket, scattered through the members' page tables at their
+        ``prefilled_len`` watermarks — the SAME compiled program shape
+        as a tail-prefill wave, so chunking adds zero compiles.  A
+        member whose watermark reaches its prompt end commits its
+        first token and joins the decode batch."""
+        rows = self.prefill_batch
+        chunks = []
+        for r in wave:
+            eff = r.effective_prompt
+            clen = self._next_chunk_len(r)
+            chunks.append(eff[r.prefilled_len:r.prefilled_len + clen])
+        bucket = self.bucketer.bucket_for(int(chunks[0].size))
+        ids, lengths = self.bucketer.pad_batch(
+            chunks, bucket, rows, self.pad_id
+        )
+        sentinel = self.num_pages
+        tables = np.full(
+            (rows, self.max_pages_per_request), sentinel, np.int32
+        )
+        index = np.zeros((rows,), np.int32)
+        valid = np.zeros((rows,), np.int32)  # pad rows: writes drop
+        for i, r in enumerate(wave):
+            held = self._pool.table(r.request_id)
+            tables[i, : len(held)] = held
+            index[i] = r.prefilled_len
+            valid[i] = r.prefilled_len + int(chunks[i].size)
+
+        tracer = get_tracer()
+        span0 = tracer.now() if tracer is not None else 0.0
+        t0 = time.perf_counter()
+        compiles0 = xla_compile_count()
+        data = self._run_paged_stages(
+            ids, tables, index, valid, tracer, "prefill",
+            {"bucket": bucket, "chunk": True},
+        )
+        pos = device_put_elided(lengths - 1, self._last_device)
+        logits = _gather_last(data, pos)  # [rows, V]
+        tokens = _argmax_tokens(logits)
+        jax.block_until_ready(tokens)
+        now = time.perf_counter()
+        self.stats.prefill_s += now - t0
+        # per-chunk TRUE token counts: the padding-waste histogram and
+        # serving_padding_fraction() must see what this wave actually
+        # prefilled, never the members' full prompt lengths
+        wave_tokens = int(sum(int(c.size) for c in chunks))
+        if tracer is not None:
+            end_us = tracer.now()
+            tracer.complete(
+                "prefill", tracer.lane("serving", "engine"), span0,
+                {"bucket": bucket, "wave": len(wave),
+                 "tokens": wave_tokens, "chunk": True,
+                 "requests": [r.request_id for r in wave]},
+                dur_us=end_us - span0,
+            )
+        else:
+            end_us = 0.0
+        self.stats.prefill_waves += 1
+        self.stats.prefill_tokens += wave_tokens
+        self.stats.prefill_chunks += len(wave)
+        self.stats.compiles += xla_compile_count() - compiles0
+
+        finals = [
+            (i, r) for i, r in enumerate(wave)
+            if r.prefilled_len + int(chunks[i].size)
+            >= int(r.effective_prompt.size)
+        ]
+        tokens_np = np.asarray(tokens)
+        sampled = self._sampled_rows(logits, finals)
+        for i, r in enumerate(wave):
+            clen = int(chunks[i].size)
+            r.prefilled_len += clen
+            if r.prefilled_len < int(r.effective_prompt.size):
+                continue  # watermark advanced; more chunks to come
+            # final chunk: the last true position's logits seed the
+            # first generated token, exactly like a one-shot wave
+            self._prefilling.pop(r.request_id)
+            self._pool.register_prefix(
+                r.request_id, [int(t) for t in r.prompt]
+            )
+            tok = self._pick_token(r, tokens_np[i], sampled.get(i))
+            r.tokens.append(tok)
+            r.index = r.prefilled_len
+            r.prefilled_len = 0
+            r.status = RUNNING
+            self._running[r.request_id] = r
+            if r.first_token_s is None:
+                r.first_token_s = now
+            self.stats.generated_tokens += 1
+            if tracer is not None:
+                self._trace_close_prefill(r, tracer, end_us=end_us,
+                                          bucket=bucket, slot=r.slot)
+                r.trace_marks["decode"] = end_us
+            if r.done:
+                self._finish(r, now)
 
     @staticmethod
     def _effective_tokens(request: Request) -> tuple:
@@ -1913,25 +2461,10 @@ class ServingEngine(LiveMetricsMixin):
         span0 = tracer.now() if tracer is not None else 0.0
         t0 = time.perf_counter()
         compiles0 = xla_compile_count()
-        data: Any = ids
-        for st in self.stages:
-            data = device_put_elided(data, st.device)
-            tb = device_put_elided(tables, st.device)
-            ix = device_put_elided(index, st.device)
-            vl = device_put_elided(valid, st.device)
-            if tracer is None:
-                data, st.slabs = st._step_donated(
-                    st.params, data, st.slabs, tb, ix, vl
-                )
-            else:
-                stage0 = tracer.now()
-                data, st.slabs = st._step_donated(
-                    st.params, data, st.slabs, tb, ix, vl
-                )
-                tracer.complete(
-                    "prefill", tracer.lane(st.lane_name, "dispatch"),
-                    stage0, {"bucket": bucket},
-                )
+        data = self._run_paged_stages(
+            ids, tables, index, valid, tracer, "prefill",
+            {"bucket": bucket},
+        )
         pos = device_put_elided(lengths - 1, self._last_device)
         logits = _gather_last(data, pos)  # [rows, V]
         tokens = _argmax_tokens(logits)
@@ -1994,6 +2527,34 @@ class ServingEngine(LiveMetricsMixin):
             if r.done:
                 self._finish(r, now)
 
+    def _run_paged_stages(self, data, tables, index, valid, tracer,
+                          span_name, span_args=None):
+        """Thread one paged step through every stage — the ONE
+        dispatch idiom shared by tail-prefill waves, chunk waves,
+        decode ticks, and the speculative verify forward: per-stage
+        device puts, the donated step program with its same-statement
+        slab rebind, and a per-stage dispatch span named
+        ``span_name``.  Returns the last stage's output."""
+        for st in self.stages:
+            data = device_put_elided(data, st.device)
+            tb = device_put_elided(tables, st.device)
+            ix = device_put_elided(index, st.device)
+            vl = device_put_elided(valid, st.device)
+            if tracer is None:
+                data, st.slabs = st._step_donated(
+                    st.params, data, st.slabs, tb, ix, vl
+                )
+            else:
+                stage0 = tracer.now()
+                data, st.slabs = st._step_donated(
+                    st.params, data, st.slabs, tb, ix, vl
+                )
+                tracer.complete(
+                    span_name, tracer.lane(st.lane_name, "dispatch"),
+                    stage0, span_args,
+                )
+        return data
+
     def _swap_in(self, request: Request) -> bool:
         """Re-seat a swapped-out request: fresh pages, host copies
         scattered back, NO prefill — decoding continues from exactly
@@ -2055,24 +2616,9 @@ class ServingEngine(LiveMetricsMixin):
         span0 = tracer.now() if tracer is not None else 0.0
         t0 = time.perf_counter()
         compiles0 = xla_compile_count()
-        data: Any = tokens[:, None]  # [rows, 1]
-        for st in self.stages:
-            data = device_put_elided(data, st.device)
-            tb = device_put_elided(tables, st.device)
-            ix = device_put_elided(index, st.device)
-            vl = device_put_elided(valid, st.device)
-            if tracer is None:
-                data, st.slabs = st._step_donated(
-                    st.params, data, st.slabs, tb, ix, vl
-                )
-            else:
-                stage0 = tracer.now()
-                data, st.slabs = st._step_donated(
-                    st.params, data, st.slabs, tb, ix, vl
-                )
-                tracer.complete(
-                    "decode", tracer.lane(st.lane_name, "dispatch"), stage0
-                )
+        data = self._run_paged_stages(
+            tokens[:, None], tables, index, valid, tracer, "decode"
+        )
         logits = data[:, 0]  # [rows, V]
         nxt = _argmax_tokens(logits)
         jax.block_until_ready(nxt)
@@ -2098,6 +2644,145 @@ class ServingEngine(LiveMetricsMixin):
             r.index += 1
             if r.done:
                 self._finish(r, now)
+
+    def _spec_tick(self) -> None:
+        """One speculative decode tick (replaces the plain decode tick
+        while ``spec_k > 0``): the draft proposes ``spec_k`` tokens per
+        row autoregressively (``Lq=1`` against stage 0's slab prefix),
+        then the whole pipeline verifies all ``spec_k + 1`` positions
+        in ONE forward (``Lq=spec_k+1`` — a fixed shape, compiled once)
+        and greedy acceptance commits the agreed draft prefix plus the
+        target's own next token.  The committed stream is the
+        non-speculative greedy stream by construction: only the
+        target's argmax ever commits.
+
+        Rollback is a watermark truncate: rejected positions' KV sits
+        beyond the committed ``index``, masked by ``decode_visibility``
+        and rewritten by the next committed forward; page refcounts
+        never move (the admission grant already reserved the request's
+        worst-case span, so drafting k ahead is pre-charged).
+        Temperature-sampling rows ride the same verify forward and
+        commit exactly one token from its position-0 logits — the
+        identical logits a plain decode tick would produce — so their
+        sample streams are untouched (and contribute nothing to the
+        draft/accept/rollback counters: they never consume drafts).
+        A tick with NO greedy row falls back to the plain decode tick
+        — drafting for rows that cannot accept would be pure waste."""
+        active = list(self._running.values())
+        if not active:
+            return
+        if all(r.temperature > 0.0 for r in active):
+            self._decode_tick_paged()
+            return
+        k = self.spec_k
+        rows = self.max_concurrency
+        sentinel = self.num_pages
+        tokens = np.zeros((rows,), np.int32)
+        index0 = np.zeros((rows,), np.int32)
+        reserve = np.zeros((rows,), np.int32)  # inactive rows: 0 -> drop
+        tables = np.full(
+            (rows, self.max_pages_per_request), sentinel, np.int32
+        )
+        for r in active:
+            tokens[r.slot] = r.tokens[-1]
+            index0[r.slot] = r.index
+            reserve[r.slot] = int(r.prompt.size) + r.max_new_tokens
+            held = self._pool.table(r.request_id)
+            tables[r.slot, : len(held)] = held
+
+        tracer = get_tracer()
+        span0 = tracer.now() if tracer is not None else 0.0
+        t0 = time.perf_counter()
+        compiles0 = xla_compile_count()
+        stage0 = self.stages[0]
+        d = self._draft.num_attn
+        # --- draft: k sequential Lq=1 steps against stage 0's slab
+        # prefix (the draft's KV IS the target's first d layers' KV —
+        # prefix-slice sharing, see serving/speculative.py)
+        tb0 = device_put_elided(tables, stage0.device)
+        # the ENTIRE k-step autoregressive draft is one compiled
+        # program (DraftModel.draft_k, k static): one dispatch and one
+        # device->host transfer per tick, not k of each
+        drafted_dev, new_prefix = self._draft.draft_k(
+            device_put_elided(tokens, stage0.device),
+            stage0.slabs[:d], tb0,
+            device_put_elided(index0, stage0.device),
+            device_put_elided(reserve, stage0.device), k,
+        )
+        stage0.slabs = list(new_prefix) + stage0.slabs[d:]
+        drafted = np.asarray(drafted_dev, dtype=np.int32)
+        if tracer is not None:
+            tracer.complete(
+                "draft", tracer.lane("serving", "engine"), span0,
+                {"active": len(active), "spec_k": k},
+            )
+        # --- verify: one Lq=k+1 forward over the whole pipeline
+        verify_span0 = tracer.now() if tracer is not None else 0.0
+        verify_in = np.concatenate([tokens[:, None], drafted], axis=1)
+        valid = np.minimum(index0 + k + 1, reserve)
+        logits3 = self._run_paged_stages(
+            verify_in, tables, index0, valid, tracer, "decode"
+        )  # [rows, k+1, V]
+        target = _argmax_tokens(logits3)  # [rows, k+1]
+        jax.block_until_ready(target)
+        now = time.perf_counter()
+        self.stats.decode_s += now - t0
+        if tracer is not None:
+            tracer.complete(
+                "decode", tracer.lane("serving", "engine"), verify_span0,
+                {"active": len(active), "spec_k": k},
+            )
+        self.stats.compiles += xla_compile_count() - compiles0
+
+        target_np = np.asarray(target)
+        sampled = self._sampled_rows(
+            logits3[:, 0], [(r.slot, r) for r in active]
+        )
+        committed_total = 0
+        for r in active:
+            row = r.slot
+            if r.temperature > 0.0:
+                # position-0 logits == the plain decode tick's logits;
+                # the drafts for this row are discarded (sampling has
+                # no greedy acceptance rule) and never counted —
+                # accept-rate observability describes greedy traffic
+                tok = self._pick_token(
+                    r, target_np[row, 0], sampled.get(row)
+                )
+                commit = [tok][: min(1, r.remaining)]
+            else:
+                remaining = r.remaining
+                accepted = greedy_accept_count(
+                    drafted[row], target_np[row, :k]
+                )
+                commit = (
+                    [int(t) for t in drafted[row, :accepted]]
+                    + [int(target_np[row, accepted])]
+                )
+                ncommit = min(len(commit), remaining)
+                commit = commit[:ncommit]
+                # the accept-rate denominator counts only USABLE
+                # proposals: a row whose remaining budget is below k
+                # could never consume the surplus drafts (the fixed
+                # draft shape still computes them), and charging them
+                # would deflate the rate below 1.0 for a PERFECT draft
+                self.stats.draft_tokens += min(k, remaining)
+                self.stats.accepted_draft_tokens += min(
+                    accepted, ncommit
+                )
+                # the verify wrote min(k+1, remaining) positions (its
+                # valid cap); a rollback happened iff the committed
+                # watermark stops short of what was written
+                if ncommit < min(k + 1, remaining):
+                    self.stats.spec_rollbacks += 1
+            for tok in commit:
+                r.tokens.append(tok)
+            r.index += len(commit)
+            committed_total += len(commit)
+            if r.done:
+                self._finish(r, now)
+        self.stats.decode_tokens += committed_total
+        self.stats.generated_tokens += committed_total
 
     @staticmethod
     def _sampled_rows(logits, rows) -> Dict[int, np.ndarray]:

@@ -605,6 +605,78 @@ class RowAllocator:
 
 
 # --------------------------------------------------------------------------
+# chunked-prefill budget policy
+# --------------------------------------------------------------------------
+
+
+class ChunkBudgetPolicy:
+    """Per-tick prefill-chunk admission budget (pure scheduling).
+
+    Chunked prefill splits a prompt's non-shared tail into fixed
+    ``prefill_chunk``-token chunks that ride engine ticks alongside the
+    decode slab, so decode ticks are never stalled behind a whole
+    prompt's prefill.  This policy is the knob that bounds the
+    interleave: each tick it grants at most ``max_chunk_rows`` chunk
+    rows while any request is decoding, so **no decode tick ever waits
+    behind more than ``max_chunk_rows x prefill_chunk`` prefill
+    positions** — the starvation bound
+    (:meth:`starvation_bound_tokens`).  When nothing is decoding there
+    is nothing to starve, and the budget opens up to
+    ``idle_chunk_rows`` so a cold engine's prefill does not crawl.
+
+    Pure stdlib by contract (this module's standing rule):
+    ``tools/chunk_smoke.py`` file-path-loads it in the CI lint job and
+    drives the decision table on a bare runner.
+    """
+
+    def __init__(
+        self,
+        prefill_chunk: int,
+        max_chunk_rows: int = 1,
+        idle_chunk_rows: Optional[int] = None,
+    ):
+        if int(prefill_chunk) < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}"
+            )
+        if int(max_chunk_rows) < 1:
+            raise ValueError(
+                f"max_chunk_rows must be >= 1, got {max_chunk_rows}"
+            )
+        self.prefill_chunk = int(prefill_chunk)
+        self.max_chunk_rows = int(max_chunk_rows)
+        self.idle_chunk_rows = (
+            int(idle_chunk_rows) if idle_chunk_rows is not None
+            else max(self.max_chunk_rows, 4)
+        )
+        if self.idle_chunk_rows < self.max_chunk_rows:
+            raise ValueError(
+                f"idle_chunk_rows {self.idle_chunk_rows} must be >= "
+                f"max_chunk_rows {self.max_chunk_rows} (an idle engine "
+                f"never has less headroom than a busy one)"
+            )
+
+    def rows_for_tick(self, *, pending: int, decoding: int) -> int:
+        """Chunk rows this tick may prefill.
+
+        ``pending`` = requests holding pages mid-prefill; ``decoding``
+        = requests in the running decode batch.  Returns 0 when there
+        is nothing to chunk; otherwise the decode-protecting bound (or
+        the idle bound when no decode work exists to protect).
+        """
+        if pending <= 0:
+            return 0
+        if decoding <= 0:
+            return min(pending, self.idle_chunk_rows)
+        return min(pending, self.max_chunk_rows)
+
+    def starvation_bound_tokens(self) -> int:
+        """Worst-case prefill positions any decode tick can wait
+        behind: the chunk interleave's latency guarantee."""
+        return self.max_chunk_rows * self.prefill_chunk
+
+
+# --------------------------------------------------------------------------
 # preemption mode policy
 # --------------------------------------------------------------------------
 
@@ -654,6 +726,7 @@ def choose_preempt_mode(
 
 
 __all__ = [
+    "ChunkBudgetPolicy",
     "PageGrant",
     "PagedKVCachePool",
     "RadixPrefixIndex",

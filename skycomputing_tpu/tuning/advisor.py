@@ -20,6 +20,10 @@ skewed buckets      prefill padding waste above threshold ->
                     bucket's observed mean prompt length
 queue pressure      admission stalls on a large share of engine ticks ->
                     ``slots``: double the KV slot count
+decode tail         per-request TPOT p95/p50 above threshold (decode
+                    ticks stalling behind whole prefill waves) ->
+                    ``prefill_chunk``: enable chunked prefill at the
+                    largest sub-max bucket, or shrink one bucket if on
 clean trace         ``None`` — a healthy run is left alone
 ==================  =======================================================
 
@@ -47,6 +51,7 @@ PIPELINE_SCHEDULE = "pipeline_schedule"
 MICROBATCH_COUNT = "microbatch_count"
 SKEWED_BUCKETS = "skewed_buckets"
 QUEUE_PRESSURE = "queue_pressure"
+DECODE_TAIL = "decode_tail"
 
 
 @dataclass(frozen=True)
@@ -107,10 +112,16 @@ class TuningAdvisor:
         stall_threshold: float = 0.25,
         max_microbatches: int = 32,
         bucket_quantum: int = 8,
+        tail_ratio_threshold: float = 3.0,
     ):
         if straggler_ratio <= 1.0:
             raise ValueError(
                 f"straggler_ratio must be > 1, got {straggler_ratio}"
+            )
+        if tail_ratio_threshold <= 1.0:
+            raise ValueError(
+                f"tail_ratio_threshold must be > 1, got "
+                f"{tail_ratio_threshold}"
             )
         self.straggler_ratio = float(straggler_ratio)
         self.bubble_threshold = float(bubble_threshold)
@@ -118,6 +129,7 @@ class TuningAdvisor:
         self.stall_threshold = float(stall_threshold)
         self.max_microbatches = int(max_microbatches)
         self.bucket_quantum = int(bucket_quantum)
+        self.tail_ratio_threshold = float(tail_ratio_threshold)
 
     # --- training ----------------------------------------------------------
     def propose_training(
@@ -207,15 +219,53 @@ class TuningAdvisor:
         buckets: Sequence[int],
         num_slots: int,
         max_len: int,
+        prefill_chunk: Optional[int] = None,
         blocked: Iterable[str] = (),
     ) -> Optional[Proposal]:
-        """One proposal for a serving-engine trace, or None."""
+        """One proposal for a serving-engine trace, or None.
+
+        ``prefill_chunk`` describes the CURRENT chunked-prefill knob
+        (None = off): the decode-tail signature proposes enabling or
+        shrinking it, so the advisor must know where it stands.
+        """
         blocked = set(blocked)
         serving = report.get("serving")
         if not serving:
             return None
 
-        # 1. skewed buckets: prefill FLOPs burned on pad positions.
+        # 1. decode tail blowup: per-request TPOT p95 far above p50
+        #    means decode ticks are stalling behind whole prefill waves
+        #    (interference — the tick itself is fixed-shape and
+        #    uniform).  Chunked prefill bounds that stall: enable it,
+        #    or shrink the chunk if it is already on.  The TPOT
+        #    percentiles ride in the serving section when the acting
+        #    layer merges them from the engine's SLO stats
+        #    (ServingAutotuner does); traces without them skip the
+        #    signature.
+        tail = self._tail_ratio(serving)
+        if (DECODE_TAIL not in blocked and tail is not None
+                and tail >= self.tail_ratio_threshold):
+            new_chunk = self._chunk_proposal(buckets, prefill_chunk)
+            if new_chunk is not None:
+                action = (
+                    f"enable chunked prefill at {new_chunk}"
+                    if prefill_chunk is None
+                    else f"shrink prefill_chunk {prefill_chunk} -> "
+                         f"{new_chunk}"
+                )
+                return Proposal(
+                    knob="prefill_chunk",
+                    value=new_chunk,
+                    signature=DECODE_TAIL,
+                    metric="tpot_tail_ratio",
+                    reason=(
+                        f"tpot_p95/p50 ratio {tail:.1f} >= "
+                        f"{self.tail_ratio_threshold:.1f}: decode "
+                        f"ticks stall behind prefill waves -> {action}"
+                    ),
+                )
+
+        # 2. skewed buckets: prefill FLOPs burned on pad positions.
         #    Target the bucket wasting the most padded tokens and insert
         #    a new bucket sized to its observed mean prompt length
         #    (rounded up to the compile quantum) — one extra warmup
@@ -255,7 +305,7 @@ class TuningAdvisor:
                             ),
                         )
 
-        # 2. queue pressure: admission repeatedly found no free slot —
+        # 3. queue pressure: admission repeatedly found no free slot —
         #    concurrency is capped by the slab, not by compute
         ticks = serving.get("prefill_waves", 0) + serving.get(
             "decode_ticks", 0
@@ -277,8 +327,37 @@ class TuningAdvisor:
             )
         return None
 
+    @staticmethod
+    def _tail_ratio(serving: Dict[str, Any]) -> Optional[float]:
+        """Per-request TPOT p95/p50 from the serving section, or None
+        when the section carries no SLO percentiles (trace-only
+        reports) or the p50 is degenerate."""
+        p50 = serving.get("tpot_p50_s")
+        p95 = serving.get("tpot_p95_s")
+        if not p50 or not p95 or p50 <= 0:
+            return None
+        return float(p95) / float(p50)
+
+    @staticmethod
+    def _chunk_proposal(
+        buckets: Sequence[int], prefill_chunk: Optional[int],
+    ) -> Optional[int]:
+        """The next chunked-prefill operating point: enable at the
+        largest bucket below the max (chunking at the max bucket is a
+        no-op), else shrink to the next smaller bucket; None when
+        already at the smallest bucket (or the bucket set offers no
+        smaller shape) — the signature has nothing left to actuate."""
+        ordered = sorted(set(int(b) for b in buckets))
+        if len(ordered) < 2:
+            return None
+        if prefill_chunk is None:
+            return ordered[-2]
+        smaller = [b for b in ordered if b < int(prefill_chunk)]
+        return smaller[-1] if smaller else None
+
 
 __all__ = [
+    "DECODE_TAIL",
     "MICROBATCH_COUNT",
     "PIPELINE_SCHEDULE",
     "Proposal",

@@ -114,6 +114,10 @@ class ServingAutotuner:
         self._window_t0: Optional[float] = None
         self._pending: Optional[Dict[str, Any]] = None
         self._arc_id = 0
+        # window-scoped SLO sampling: TPOT samples appended since the
+        # window opened (the decode-tail signature needs per-request
+        # percentiles, which the trace alone does not carry)
+        self._tpot_mark = 0
         engine.autotuner = self
 
     # --- trace plumbing ----------------------------------------------------
@@ -132,6 +136,7 @@ class ServingAutotuner:
         if self._window_t0 is None:
             self._window_t0 = tracer.now()
             self._window_start_step = self._steps
+            self._tpot_mark = len(engine.stats.tpot_s)
             return
         if self._steps - self._window_start_step < self.tune_every:
             return
@@ -142,8 +147,10 @@ class ServingAutotuner:
                 report = analyze(window_events(tracer, self._window_t0))
             except TraceError:
                 report = None
+        self._merge_window_slo(report, engine)
         self._window_t0 = tracer.now()
         self._window_start_step = self._steps
+        self._tpot_mark = len(engine.stats.tpot_s)
         if report is None:
             return
         if self._pending is not None:
@@ -151,17 +158,49 @@ class ServingAutotuner:
             return
         if self.tunes >= self.max_tunes:
             return
+        blocked = set(self.blocked)
+        if not getattr(engine, "_paged", False):
+            # prefill_chunk is a paged-only knob: a slot engine would
+            # reject the proposal and burn the signature forever —
+            # mask it instead of spending a blocked slot on it
+            from .advisor import DECODE_TAIL
+
+            blocked.add(DECODE_TAIL)
         proposal = self.advisor.propose_serving(
             report,
             buckets=engine.bucketer.buckets,
             num_slots=engine.num_slots,
             max_len=engine.max_len,
-            blocked=self.blocked,
+            prefill_chunk=getattr(engine, "prefill_chunk", None),
+            blocked=blocked,
         )
         if proposal is None:
             self._record(NO_OP)
             return
         self._apply(tracer, report, proposal)
+
+    def _merge_window_slo(self, report: Optional[Dict[str, Any]],
+                          engine) -> None:
+        """Fold the WINDOW's per-request TPOT percentiles into the
+        report's serving section (the decode-tail signature's input —
+        one merge site, so decide and judge read the same numbers).
+        Windows with too few finished requests carry no percentiles:
+        two samples cannot distinguish a tail from noise."""
+        if report is None or not report.get("serving"):
+            return
+        samples = engine.stats.tpot_s
+        window = [s for s in samples[self._tpot_mark:] if s is not None]
+        if len(window) < 4:
+            return
+        ordered = sorted(window)
+
+        def pct(q):
+            i = min(len(ordered) - 1,
+                    max(0, int(round(q / 100.0 * (len(ordered) - 1)))))
+            return float(ordered[i])
+
+        report["serving"]["tpot_p50_s"] = pct(50)
+        report["serving"]["tpot_p95_s"] = pct(95)
 
     def _metric(self, report: Dict[str, Any], name: str) -> Optional[float]:
         serving = report.get("serving") or {}
@@ -176,6 +215,12 @@ class ServingAutotuner:
             if ticks <= 0:
                 return None
             return serving.get("queue_stalls", 0) / ticks
+        if name == "tpot_tail_ratio":
+            p50 = serving.get("tpot_p50_s")
+            p95 = serving.get("tpot_p95_s")
+            if not p50 or not p95 or p50 <= 0:
+                return None
+            return float(p95) / float(p50)
         return None
 
     def _apply(self, tracer, report: Dict[str, Any],
@@ -189,6 +234,10 @@ class ServingAutotuner:
         revert = dict(buckets=list(engine.bucketer.buckets),
                       num_slots=engine.num_slots,
                       prefill_batch=engine.prefill_batch)
+        if getattr(engine, "_paged", False):
+            # 0 = "chunking off" in reconfigure's knob language; slot
+            # engines never see the key (they would reject it)
+            revert["prefill_chunk"] = engine.prefill_chunk or 0
         self._arc_id += 1
         tracer.async_begin("autotune", self._lane(tracer), self._arc_id,
                            proposal.describe())
@@ -199,6 +248,8 @@ class ServingAutotuner:
                     engine.reconfigure(buckets=proposal.value)
                 elif proposal.knob == "slots":
                     engine.reconfigure(num_slots=proposal.value)
+                elif proposal.knob == "prefill_chunk":
+                    engine.reconfigure(prefill_chunk=proposal.value)
                 else:
                     raise ValueError(
                         f"serving tuner cannot actuate knob "

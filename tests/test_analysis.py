@@ -733,6 +733,16 @@ def test_payload_schema_accepts_serving_context():
                         "buckets": [16, 32, 64]},
         }
     ) == []
+    # chunked-prefill + speculation knobs ride the same schema
+    assert verify_allocation_payload(
+        {
+            "device_scale": {"0": 1.0},
+            "serving": {"slots": 8, "max_len": 256,
+                        "buckets": [16, 32, 64],
+                        "prefill_chunk": 32, "spec_k": 3,
+                        "draft_mb": 12.5},
+        }
+    ) == []
 
 
 @pytest.mark.parametrize(
@@ -750,6 +760,15 @@ def test_payload_schema_accepts_serving_context():
          "strictly increasing"),
         ({"slots": 4, "max_len": 64, "buckets": [8, 128]},
          "exceeds serving.max_len"),
+        ({"slots": 4, "max_len": 64, "prefill_chunk": 0},
+         "serving.prefill_chunk must be"),
+        ({"slots": 4, "max_len": 64, "buckets": [8, 16],
+          "prefill_chunk": 12},
+         "not one of serving.buckets"),
+        ({"slots": 4, "max_len": 64, "spec_k": -1},
+         "serving.spec_k must be"),
+        ({"slots": 4, "max_len": 64, "draft_mb": -0.5},
+         "serving.draft_mb must be"),
     ],
 )
 def test_payload_schema_rejects_malformed_serving(serving, needle):
@@ -786,6 +805,32 @@ def test_serving_kv_memory_failure_names_context():
     assert verify_plan(
         _model_cfg(), _wm([4, 4], mem_limit=1.5), (X,), memory="error"
     ).ok
+
+
+def test_serving_draft_mb_charged_on_first_stage():
+    """The speculative draft's resident head copy counts against the
+    FIRST stage's budget (that is where serving/speculative.py puts
+    it): a draft_mb that alone overflows stage 0 is rejected with the
+    draft named, while the draft-free context passes."""
+    serving = dict(slots=1, max_len=4,
+                   kv_mb_per_layer=[0.0] * N_UNITS)
+    assert verify_plan(
+        _model_cfg(), _wm([4, 4], mem_limit=1.5), (X,), memory="error",
+        serving=dict(serving),
+    ).ok
+    report = verify_plan(
+        _model_cfg(), _wm([4, 4], mem_limit=1.5), (X,), memory="error",
+        serving=dict(serving, draft_mb=50.0),
+    )
+    assert not report.ok
+    msg = report.errors[0].message
+    assert "rank 0" in msg and "speculative draft params" in msg
+    # malformed draft_mb degrades to a diagnostic, never a TypeError
+    report = verify_plan(
+        _model_cfg(), _wm([4, 4], mem_limit=1.5), (X,), memory="error",
+        serving=dict(serving, draft_mb="big"),
+    )
+    assert any("draft_mb" in i.message for i in report.issues)
 
 
 def test_serving_kv_profile_computed_from_gpt_config():

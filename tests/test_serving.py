@@ -663,6 +663,422 @@ def test_paged_reconfigure_verify_then_apply(gpt):
         slot.reconfigure(num_pages=8)
 
 
+# --------------------------------------------------------------------------
+# chunked prefill + speculative decoding
+# --------------------------------------------------------------------------
+
+
+def test_chunk_budget_policy_contract():
+    """Pure scheduling: the budget defers chunk rows while decode
+    exists to protect, opens up when idle, and its starvation bound is
+    rows x chunk."""
+    from skycomputing_tpu.serving import ChunkBudgetPolicy
+
+    policy = ChunkBudgetPolicy(16, max_chunk_rows=2, idle_chunk_rows=6)
+    assert policy.rows_for_tick(pending=0, decoding=5) == 0
+    assert policy.rows_for_tick(pending=8, decoding=3) == 2
+    assert policy.rows_for_tick(pending=1, decoding=3) == 1
+    assert policy.rows_for_tick(pending=8, decoding=0) == 6
+    assert policy.rows_for_tick(pending=4, decoding=0) == 4
+    assert policy.starvation_bound_tokens() == 32
+    with pytest.raises(ValueError):
+        ChunkBudgetPolicy(0)
+    with pytest.raises(ValueError):
+        ChunkBudgetPolicy(16, max_chunk_rows=0)
+    with pytest.raises(ValueError):
+        ChunkBudgetPolicy(16, max_chunk_rows=4, idle_chunk_rows=2)
+
+
+def test_chunked_prefill_token_identity(gpt):
+    """Chunked prefill is pure scheduling: every output matches the
+    one-shot `generate` AND the unchunked paged engine, with chunk
+    waves actually taken and decode interleaved between them."""
+    layer_cfgs, params, fwd = gpt
+    rng = np.random.default_rng(21)
+    specs = [(14, 6), (5, 9), (16, 3), (12, 7), (3, 4), (15, 5)]
+    chunked_reqs = mixed_requests(rng, specs)
+    plain_reqs = [
+        Request(prompt=r.prompt.copy(), max_new_tokens=r.max_new_tokens)
+        for r in chunked_reqs
+    ]
+    chunked = paged_engine(layer_cfgs, params, prefill_batch=2,
+                           prefill_chunk=8)
+    plain = paged_engine(layer_cfgs, params, prefill_batch=2)
+    c_out = chunked.run(chunked_reqs)
+    p_out = plain.run(plain_reqs)
+    for cr, pr in zip(chunked_reqs, plain_reqs):
+        np.testing.assert_array_equal(
+            c_out[cr.request_id], reference(fwd, cr)
+        )
+        np.testing.assert_array_equal(
+            c_out[cr.request_id], p_out[pr.request_id]
+        )
+    assert chunked.stats.prefill_chunks > 0
+    # prompts longer than one chunk took several waves
+    assert chunked.stats.prefill_chunks > len(specs)
+    chunked._pool.check_consistency()
+
+
+def test_chunked_midprefill_preempt_and_drain(gpt):
+    """A mid-watermark request preempts (recompute-only) and drains
+    with its stream intact; refcounts stay consistent throughout."""
+    layer_cfgs, params, fwd = gpt
+    engine = ServingEngine(
+        layer_cfgs, params, num_slots=2, max_len=48,
+        buckets=(4, 8, 16), kv_layout="paged", page_size=4,
+        prefill_batch=1, prefill_chunk=4, max_chunk_rows=1,
+    )
+    rng = np.random.default_rng(22)
+    victim, other = mixed_requests(rng, [(15, 6), (5, 4)])
+    engine.submit(victim)
+    engine.submit(other)
+    engine.step()  # enrolls; victim advances at most one chunk
+    assert victim.request_id in engine._prefilling
+    assert victim.prefilled_len > 0
+    with pytest.raises(ValueError, match="recomputation"):
+        engine.preempt(victim.request_id, mode="swap")
+    engine.preempt(victim.request_id)
+    assert victim.slot is None and victim.prefilled_len == 0
+    engine._pool.check_consistency()
+    engine.run()
+    np.testing.assert_array_equal(victim.output(), reference(fwd, victim))
+    np.testing.assert_array_equal(other.output(), reference(fwd, other))
+    # drain() evicts mid-prefill requests too (the migration primitive)
+    r2 = mixed_requests(rng, [(15, 5)])[0]
+    engine.submit(r2)
+    engine.step()
+    drained = engine.drain()
+    assert r2 in drained and not engine.has_work()
+    engine._pool.check_consistency()
+
+
+class _SabotagedDraft:
+    """A draft that always proposes the WRONG token (off by one in
+    vocab space): every verify tick must reject at the first position,
+    exercising the full rollback path while the greedy stream stays
+    token-identical by construction."""
+
+    def __init__(self, inner, vocab):
+        self._inner = inner
+        self._vocab = vocab
+        self.num_attn = inner.num_attn
+        self.extra_param_mb = inner.extra_param_mb
+
+    def draft_k(self, tokens, slabs, tables, index, reserve, k):
+        proposals, slabs = self._inner.draft_k(
+            tokens, slabs, tables, index, reserve, k
+        )
+        return (proposals + 1) % self._vocab, slabs
+
+
+def test_spec_rejection_rollback_keeps_refcounts_and_identity(gpt):
+    """Speculation with a 100%-rejecting draft: every tick drafts k,
+    rejects at position 0, truncates the watermark, and commits the
+    target's own token — outputs stay exactly the non-speculative
+    greedy stream and page refcounts never drift."""
+    layer_cfgs, params, fwd = gpt
+    engine = paged_engine(layer_cfgs, params, prefill_batch=2,
+                          spec_k=2, draft_blocks=1)
+    engine._draft = _SabotagedDraft(engine._draft, vocab=512)
+    rng = np.random.default_rng(23)
+    requests = mixed_requests(rng, [(5, 8), (12, 5), (3, 6), (9, 4)])
+    outputs = engine.run(requests)
+    for r in requests:
+        np.testing.assert_array_equal(
+            outputs[r.request_id], reference(fwd, r)
+        )
+    stats = engine.stats
+    assert stats.draft_tokens > 0
+    # total rejection: nothing accepted, every verify tick rolled back
+    assert stats.accepted_draft_tokens == 0
+    assert stats.spec_rollbacks > 0
+    engine._pool.check_consistency()
+
+
+def test_spec_acceptance_commits_multiple_tokens(gpt):
+    """With the honest prefix-slice draft, accepted tokens commit in
+    bulk: generated tokens exceed verify ticks whenever acceptance
+    lands, and identity holds either way."""
+    layer_cfgs, params, fwd = gpt
+    engine = paged_engine(layer_cfgs, params, prefill_batch=2,
+                          spec_k=3, draft_blocks=1)
+    rng = np.random.default_rng(24)
+    requests = mixed_requests(rng, [(5, 12), (8, 10), (12, 8)])
+    outputs = engine.run(requests)
+    for r in requests:
+        np.testing.assert_array_equal(
+            outputs[r.request_id], reference(fwd, r)
+        )
+    stats = engine.stats
+    assert stats.draft_tokens > 0
+    assert stats.accepted_draft_tokens >= 0  # model-dependent
+    # bookkeeping: every committed token is decode or prefill output
+    assert stats.generated_tokens == sum(
+        len(r.tokens) for r in requests
+    )
+    engine._pool.check_consistency()
+
+
+def test_spec_exact_draft_accept_rate_is_one(gpt):
+    """With a PERFECT draft (tail blocks' residual projections zeroed,
+    the bench's exact-draft surgery) the accept rate reads exactly 1.0
+    and no rollback fires — even when generation budgets are not
+    multiples of spec_k+1, because the denominator counts only USABLE
+    proposals (a final tick's surplus drafts are not failures)."""
+    from tools.bench_serving import zero_tail_residuals
+
+    layer_cfgs, params, _ = gpt
+    sparams = zero_tail_residuals(layer_cfgs, list(params), 1)
+    spec = paged_engine(layer_cfgs, sparams, prefill_batch=2,
+                        spec_k=3, draft_blocks=1)
+    plain = paged_engine(layer_cfgs, sparams, prefill_batch=2)
+    rng = np.random.default_rng(28)
+    # budgets 6 and 9: both hit the remaining-cap tick (6 = 4+2,
+    # 9 = 4+4+1 under spec_k=3)
+    spec_reqs = mixed_requests(rng, [(5, 6), (8, 9)])
+    plain_reqs = [
+        Request(prompt=r.prompt.copy(), max_new_tokens=r.max_new_tokens)
+        for r in spec_reqs
+    ]
+    s_out = spec.run(spec_reqs)
+    p_out = plain.run(plain_reqs)
+    for sr, pr in zip(spec_reqs, plain_reqs):
+        np.testing.assert_array_equal(
+            s_out[sr.request_id], p_out[pr.request_id]
+        )
+    stats = spec.stats
+    assert stats.draft_tokens > 0
+    assert stats.accepted_draft_tokens == stats.draft_tokens
+    assert stats.spec_rollbacks == 0
+
+
+def test_spec_sampling_rows_keep_streams_and_counters_clean(gpt):
+    """Temperature rows under speculation: the sample stream is
+    identical to the non-speculative engine's (`fold_in(seed, pos)` is
+    position-keyed, and the verify's position-0 logits ARE the decode
+    logits), and an all-sampling batch falls back to the plain decode
+    tick — no drafts burned, no accept-rate pollution."""
+    layer_cfgs, params, _ = gpt
+    rng = np.random.default_rng(27)
+    prompt = rng.integers(1, 512, (7,)).astype(np.int32)
+    spec = paged_engine(layer_cfgs, params, spec_k=2, draft_blocks=1)
+    plain = paged_engine(layer_cfgs, params)
+    r_spec = Request(prompt=prompt.copy(), max_new_tokens=6,
+                     temperature=0.8, seed=5)
+    r_plain = Request(prompt=prompt.copy(), max_new_tokens=6,
+                      temperature=0.8, seed=5)
+    o_spec = spec.run([r_spec])[r_spec.request_id]
+    o_plain = plain.run([r_plain])[r_plain.request_id]
+    np.testing.assert_array_equal(o_spec, o_plain)
+    # the all-sampling tick fell back: sampling consumed zero drafts
+    assert spec.stats.draft_tokens == 0
+    assert spec.stats.spec_rollbacks == 0
+
+
+def test_chunk_spec_zero_steady_state_recompiles(gpt):
+    """With chunking AND speculation live, one warmup pass compiles
+    every program (bucket prefills reused by chunk waves, draft Lq=1,
+    verify Lq=k+1) and the steady state pins ZERO XLA compiles."""
+    layer_cfgs, params, fwd = gpt
+    engine = ServingEngine(
+        layer_cfgs, params, num_slots=3, max_len=48, buckets=(8, 16),
+        kv_layout="paged", page_size=8, prefill_batch=2,
+        prefill_chunk=8, spec_k=2, draft_blocks=1,
+    )
+    rng = np.random.default_rng(25)
+    # warmup: every bucket + chunked multi-wave prefill + spec ticks
+    engine.run(mixed_requests(rng, [(5, 4), (14, 4), (11, 3)]))
+    warm = xla_compile_count()
+    wave = mixed_requests(
+        rng, [(6, 8), (2, 3), (15, 5), (9, 4), (13, 6)]
+    )
+    outputs = engine.run(wave)
+    assert xla_compile_count() == warm, (
+        "chunked+speculative steady state recompiled"
+    )
+    for r in wave:
+        np.testing.assert_array_equal(
+            outputs[r.request_id], reference(fwd, r)
+        )
+
+
+def test_chunk_spec_reconfigure_verify_then_apply(gpt):
+    """The chunk/spec knobs ride reconfigure's verify-then-apply: an
+    off-bucket chunk or a malformed spec_k is rejected with the engine
+    untouched; enable/disable apply cleanly with live requests, and
+    disabling chunking re-queues mid-watermark requests instead of
+    stranding them."""
+    from skycomputing_tpu.analysis.plan_check import PlanError
+
+    layer_cfgs, params, fwd = gpt
+    engine = paged_engine(layer_cfgs, params, prefill_batch=2,
+                          draft_blocks=1)
+    rng = np.random.default_rng(26)
+    requests = mixed_requests(rng, [(5, 10), (12, 8)])
+    for r in requests:
+        engine.submit(r)
+    for _ in range(2):
+        engine.step()
+    # rejections: engine exactly as it was
+    with pytest.raises(PlanError, match="prefill_chunk"):
+        engine.reconfigure(prefill_chunk=5)  # not a bucket
+    assert engine.prefill_chunk is None
+    with pytest.raises(PlanError, match="spec_k"):
+        engine.reconfigure(spec_k=-1)
+    assert engine.spec_k == 0
+    no_draft = paged_engine(layer_cfgs, params)
+    with pytest.raises(ValueError, match="draft_blocks"):
+        no_draft.reconfigure(spec_k=2)
+    assert no_draft.spec_k == 0 and no_draft._draft is None
+    # a rows knob with chunking off fails loudly (constructor parity),
+    # never silently dropping the operator's starvation bound
+    with pytest.raises(ValueError, match="requires prefill_chunk"):
+        engine.reconfigure(max_chunk_rows=4)
+    # apply: enable both, keep serving, disable both, keep serving
+    engine.reconfigure(prefill_chunk=8, spec_k=2)
+    assert engine.prefill_chunk == 8 and engine.spec_k == 2
+    assert engine._draft is not None
+    more = mixed_requests(rng, [(14, 6), (6, 5)])
+    for r in more:
+        engine.submit(r)
+    engine.step()  # may hold a mid-watermark request
+    engine.reconfigure(prefill_chunk=0, spec_k=0)
+    assert engine.prefill_chunk is None and engine.spec_k == 0
+    assert not engine._prefilling  # nothing stranded mid-watermark
+    engine.run()
+    for r in requests + more:
+        np.testing.assert_array_equal(r.output(), reference(fwd, r))
+    engine._pool.check_consistency()
+
+
+def test_chunk_tick_is_fair_and_counts_real_deferrals(gpt):
+    """One tick gives each mid-prefill request AT MOST one chunk (the
+    head can never eat the budget while later enrollees starve), and
+    `chunk_stalls` counts only ticks that actually deferred someone —
+    a lone request chunking through its prompt is not a stall."""
+    layer_cfgs, params, fwd = gpt
+    # lone request: 4 chunk ticks, zero stalls
+    solo = ServingEngine(
+        layer_cfgs, params, num_slots=2, max_len=48, buckets=(4, 16),
+        kv_layout="paged", page_size=4, prefill_batch=1,
+        prefill_chunk=4, max_chunk_rows=1,
+    )
+    r = mixed_requests(np.random.default_rng(30), [(15, 3)])[0]
+    solo.run([r])
+    assert solo.stats.prefill_chunks >= 3
+    assert solo.stats.chunk_stalls == 0
+    np.testing.assert_array_equal(r.output(), reference(fwd, r))
+    # two enrollees, prefill_batch=1 so each wave holds one request:
+    # a budget of 2 must advance BOTH every tick (head first, then the
+    # next un-advanced enrollee) — never the head twice
+    pair = ServingEngine(
+        layer_cfgs, params, num_slots=3, max_len=48, buckets=(4, 16),
+        kv_layout="paged", page_size=4, prefill_batch=1,
+        prefill_chunk=4, max_chunk_rows=2,
+    )
+    rng = np.random.default_rng(31)
+    a, b = mixed_requests(rng, [(15, 3), (14, 3)])
+    pair.submit(a)
+    pair.submit(b)
+    pair.step()  # both enroll; both must advance exactly one chunk
+    assert a.request_id in pair._prefilling
+    assert b.request_id in pair._prefilling
+    assert a.prefilled_len == 4 and b.prefilled_len == 4
+    pair.run()
+    np.testing.assert_array_equal(a.output(), reference(fwd, a))
+    np.testing.assert_array_equal(b.output(), reference(fwd, b))
+
+
+def test_reconfigure_spec_enable_charges_draft_memory(gpt, devices):
+    """Enabling speculation via reconfigure makes the draft's LM-head
+    copy newly resident on stage 0 — the verify-then-apply pre-flight
+    must charge it BEFORE the device_put, so a budget that fits the
+    slabs but not the draft rejects cleanly with the engine untouched."""
+    from skycomputing_tpu.analysis.plan_check import PlanError
+    from skycomputing_tpu.dynamics import WorkerManager
+
+    layer_cfgs, params, _ = gpt
+
+    def build(limit0):
+        wm = WorkerManager()
+        wm.load_worker_pool_from_config([
+            dict(name=f"n{i}", device_config=dict(device_index=i),
+                 extra_config=dict(mem_limit=limit))
+            for i, limit in enumerate((limit0, 10_000.0))
+        ])
+        cursor = 0
+        for w, c in zip(wm.worker_pool, [3, 3]):
+            w.model_config = layer_cfgs[cursor:cursor + c]
+            w.order = w.rank + 1
+            cursor += c
+        return ServingEngine(
+            layer_cfgs, params, num_slots=2, max_len=32, buckets=(8,),
+            worker_manager=wm, devices=devices, kv_layout="paged",
+            page_size=8, draft_blocks=1,
+        )
+
+    # stage 0 fits slabs+model (~0.71 MB) but NOT the ~0.13 MB head
+    # copy the spec enable would add
+    engine = build(limit0=0.78)
+    assert engine._pending_draft_mb() > 0.1
+    with pytest.raises(PlanError, match="speculative draft"):
+        engine.reconfigure(spec_k=2)
+    assert engine.spec_k == 0 and engine._draft is None
+    # with headroom the same enable applies and stamps the charge
+    roomy = build(limit0=10_000.0)
+    roomy.reconfigure(spec_k=2)
+    assert roomy.spec_k == 2 and roomy._draft is not None
+    assert roomy._draft_mb == pytest.approx(
+        roomy._draft.extra_param_mb
+    )
+
+
+def test_spec_preflight_charges_draft_memory():
+    """The knob schema validates prefill_chunk/spec_k, and a serving
+    context's draft_mb reaches the memory verifier."""
+    from skycomputing_tpu.analysis.plan_check import verify_tuning_knobs
+
+    report = verify_tuning_knobs(buckets=(8, 16), max_len=48,
+                                 prefill_chunk=8, spec_k=3)
+    assert not report.errors
+    report = verify_tuning_knobs(buckets=(8, 16), max_len=48,
+                                 prefill_chunk=12)
+    assert any("prefill_chunk" in i.message for i in report.errors)
+    report = verify_tuning_knobs(spec_k=-2)
+    assert any("spec_k" in i.message for i in report.errors)
+    report = verify_tuning_knobs(max_len=4, spec_k=8)
+    assert any("verify window" in i.message for i in report.errors)
+
+
+@pytest.mark.slow
+def test_bench_serving_chunk_spec_smoke(tmp_path):
+    """`bench_serving --chunked --spec --smoke` completes with the
+    mechanics gates green (token identity both ways, zero steady-state
+    recompiles, chunks and drafts counted) and the artifact carries
+    the ITL/accept-rate schema the full-run gates read."""
+    out = tmp_path / "BENCH_chunk_spec.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.bench_serving", "--chunked",
+         "--spec", "--smoke", "--out", str(out)],
+        capture_output=True, text=True, timeout=560,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    import json
+
+    report = json.loads(out.read_text())
+    chunked = report["chunked_prefill"]
+    assert chunked["gates"]["chunk_token_identical"]
+    assert chunked["gates"]["chunk_matches_unchunked"]
+    assert chunked["gates"]["zero_steady_state_recompiles"]
+    assert chunked["chunked"]["itl_p95_s"] is not None
+    spec = report["speculative"]
+    assert spec["gates"]["spec_token_identical"]
+    assert spec["gates"]["spec_matches_plain"]
+    assert spec["gates"]["zero_steady_state_recompiles"]
+    assert spec["draft_exact"] is True
+    assert spec["accept_rate"] == 1.0
+
+
 @pytest.mark.slow
 def test_bench_serving_paged_smoke(tmp_path):
     """`bench_serving --paged --smoke` completes with every gate green:

@@ -381,6 +381,66 @@ def test_serving_trace_has_phase_spans(tmp_path):
     assert snap["serving"]["finished"] == 2
 
 
+def test_chunked_prefill_spans_carry_true_chunk_tokens(tmp_path):
+    """Under chunked prefill, every engine-lane prefill span carries
+    its CHUNK's true token count — never the member's full prompt — so
+    the per-bucket padding-waste histogram and
+    ``serving_padding_fraction()`` stay correct: summed histogram
+    tokens equal the tokens actually prefilled, and the fraction stays
+    a fraction.  (A span that carried full prompt lengths would
+    multiply-count each prompt once per chunk and push the 'fraction'
+    past/below its [0, 1) range.)"""
+    from skycomputing_tpu.builder import build_layer_stack
+    from skycomputing_tpu.models.gpt import GptConfig, gpt_layer_configs
+    from skycomputing_tpu.serving import Request, ServingEngine
+    from skycomputing_tpu.telemetry.analysis import (
+        request_timeline,
+        serving_padding_fraction,
+    )
+
+    cfg = GptConfig(vocab_size=256, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=2, max_position_embeddings=64,
+                    dropout_prob=0.0, dtype="float32")
+    layer_cfgs = gpt_layer_configs(cfg, deterministic=True)
+    stack = build_layer_stack(layer_cfgs)
+    params = stack.init(jax.random.key(0), np.ones((1, 5), np.int32))
+
+    tracer = telemetry.enable_tracing()
+    try:
+        engine = ServingEngine(layer_cfgs, list(params), num_slots=3,
+                               max_len=48, buckets=(8, 16),
+                               prefill_batch=2, kv_layout="paged",
+                               page_size=8, prefill_chunk=8)
+        rng = np.random.default_rng(9)
+        lengths = (14, 15, 5, 11)
+        requests = [
+            Request(prompt=rng.integers(1, 256, (l,)).astype(np.int32),
+                    max_new_tokens=3)
+            for l in lengths
+        ]
+        engine.run(requests)
+        assert engine.stats.prefill_chunks > len(lengths)  # multi-chunk
+        path = tracer.write(str(tmp_path / "chunked.trace.json"))
+    finally:
+        telemetry.disable_tracing()
+
+    events = load_events(path)
+    report = analyze(events)
+    hist = report["serving"]["buckets"]
+    hist_tokens = sum(row["tokens"] for row in hist.values())
+    # every prompt position prefilled exactly once across all chunks
+    assert hist_tokens == sum(lengths)
+    padding = serving_padding_fraction(report["serving"])
+    assert padding is not None and 0.0 <= padding < 1.0
+    assert report["serving"]["padding_fraction"] == round(padding, 4)
+    # the request-lane waterfall stays well-formed: one prefill
+    # segment spanning enrollment -> final chunk, then decode
+    timeline = request_timeline(events, requests[0].request_id)
+    seg_names = [s["name"] for s in timeline["segments"]]
+    assert "prefill" in seg_names and "decode" in seg_names
+    assert timeline["complete"] and timeline["orphan_spans"] == 0
+
+
 # --------------------------------------------------------------------------
 # metrics unification + hook satellites
 # --------------------------------------------------------------------------

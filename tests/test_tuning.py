@@ -181,6 +181,99 @@ def test_serving_signatures():
     ) is None
 
 
+def test_decode_tail_signature_enables_then_shrinks_chunking():
+    """tpot p95/p50 past the threshold proposes the prefill_chunk
+    knob: enable at the largest sub-max bucket when off, shrink one
+    bucket when on, nothing left at the floor; blocked and
+    missing-percentile reports stay quiet."""
+    from skycomputing_tpu.tuning.advisor import DECODE_TAIL
+
+    advisor = TuningAdvisor(tail_ratio_threshold=3.0)
+    tail = {
+        "stage_busy_ms": {"0": 50.0},
+        "bubble_fraction": 0.2,
+        "serving": {
+            "prefill_waves": 10, "decode_ticks": 40, "queue_stalls": 0,
+            "tpot_p50_s": 0.03, "tpot_p95_s": 0.60,  # 20x blowup
+            "buckets": {"16": {"waves": 10, "requests": 10,
+                               "tokens": 150}},
+        },
+    }
+    p = advisor.propose_serving(tail, buckets=(16, 32, 64), num_slots=4,
+                                max_len=128, prefill_chunk=None)
+    assert (p.knob, p.value, p.signature) == (
+        "prefill_chunk", 32, DECODE_TAIL
+    )
+    assert p.metric == "tpot_tail_ratio"
+    # already chunking -> shrink one bucket
+    p = advisor.propose_serving(tail, buckets=(16, 32, 64), num_slots=4,
+                                max_len=128, prefill_chunk=32)
+    assert (p.knob, p.value) == ("prefill_chunk", 16)
+    # at the floor -> nothing left to actuate
+    assert advisor.propose_serving(
+        tail, buckets=(16, 32, 64), num_slots=4, max_len=128,
+        prefill_chunk=16,
+    ) is None
+    # blocked signature falls through (no other signature fires here)
+    assert advisor.propose_serving(
+        tail, buckets=(16, 32, 64), num_slots=4, max_len=128,
+        prefill_chunk=None, blocked={DECODE_TAIL},
+    ) is None
+    # a trace-only report (no merged SLO percentiles) never fires
+    quiet = dict(tail, serving={
+        k: v for k, v in tail["serving"].items()
+        if not k.startswith("tpot_")
+    })
+    assert advisor.propose_serving(
+        quiet, buckets=(16, 32, 64), num_slots=4, max_len=128,
+        prefill_chunk=None,
+    ) is None
+    # a healthy tail stays quiet
+    calm = dict(tail, serving=dict(tail["serving"], tpot_p95_s=0.05))
+    assert advisor.propose_serving(
+        calm, buckets=(16, 32, 64), num_slots=4, max_len=128,
+        prefill_chunk=None,
+    ) is None
+
+
+def test_serving_autotuner_actuates_prefill_chunk():
+    """The acting layer routes a decode-tail proposal through
+    reconfigure: the engine ends up chunking, the revert snapshot can
+    undo it, and the window-SLO merge feeds the advisor the ratio it
+    thresholds."""
+    from skycomputing_tpu.builder import build_layer_stack
+    from skycomputing_tpu.models.gpt import GptConfig, gpt_layer_configs
+    from skycomputing_tpu.serving import ServingEngine
+    from skycomputing_tpu.tuning.autotune import ServingAutotuner
+
+    cfg = GptConfig(vocab_size=256, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=2, max_position_embeddings=64,
+                    dropout_prob=0.0, dtype="float32")
+    layer_cfgs = gpt_layer_configs(cfg, deterministic=True)
+    stack = build_layer_stack(layer_cfgs)
+    params = stack.init(jax.random.key(0), np.ones((1, 5), np.int32))
+    engine = ServingEngine(layer_cfgs, list(params), num_slots=2,
+                           max_len=48, buckets=(8, 16),
+                           kv_layout="paged", page_size=8)
+    tuner = ServingAutotuner(engine)
+    # the windowed SLO merge: enough samples -> percentiles land in
+    # the serving section; the tail metric reads them back
+    engine.stats.tpot_s.extend([0.03, 0.031, 0.029, 0.030, 0.9])
+    report = {"serving": {"prefill_waves": 1, "decode_ticks": 4,
+                          "queue_stalls": 0, "buckets": {}}}
+    tuner._merge_window_slo(report, engine)
+    assert report["serving"]["tpot_p50_s"] == pytest.approx(0.030)
+    assert report["serving"]["tpot_p95_s"] == pytest.approx(0.9)
+    assert tuner._metric(report, "tpot_tail_ratio") == pytest.approx(
+        0.9 / 0.030
+    )
+    # actuation: the knob reaches reconfigure and the engine chunks
+    engine.reconfigure(prefill_chunk=8)
+    assert engine.prefill_chunk == 8
+    engine.reconfigure(prefill_chunk=0)
+    assert engine.prefill_chunk is None
+
+
 def test_bench_autotune_smoke():
     """The CI lint job's exact decide-step invocation."""
     assert run_smoke() == 0
@@ -201,6 +294,16 @@ def test_verify_tuning_knobs_contract():
     # out of the verifier (the PR 4 hardening contract)
     assert not verify_tuning_knobs(buckets=[None, 64]).ok
     assert not verify_tuning_knobs(buckets=["a", 2.5]).ok
+    # chunked-prefill / speculation knob schema
+    assert verify_tuning_knobs(buckets=(8, 16), max_len=32,
+                               prefill_chunk=8, spec_k=2).ok
+    assert verify_tuning_knobs(spec_k=0).ok  # 0 = disabled
+    assert not verify_tuning_knobs(buckets=(8, 16), max_len=32,
+                                   prefill_chunk=12).ok  # off-bucket
+    assert not verify_tuning_knobs(prefill_chunk=0).ok
+    assert not verify_tuning_knobs(spec_k=-1).ok
+    assert not verify_tuning_knobs(spec_k=True).ok
+    assert not verify_tuning_knobs(max_len=4, spec_k=6).ok
     with pytest.raises(Exception):
         verify_tuning_knobs(schedule="bogus").raise_if_failed()
 

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""CI smoke for the chunked-prefill budget policy (pure stdlib).
+
+Loads ``serving/paging.py`` by file path (the skylint idiom, so the
+lint job exercises it on a bare runner, no jax/numpy installed) and
+drives :class:`ChunkBudgetPolicy` through its decision table: the
+decode-protecting bound, the idle opening, the starvation guarantee,
+and the constructor validation.  This is the pure-scheduling half of
+chunked prefill — the engine's chunk waves obey exactly what this
+policy decides, so drift here is a latency regression waiting to ship.
+
+Usage::
+
+    python tools/chunk_smoke.py
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_by_path(name: str, *parts: str):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ROOT, *parts)
+    )
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[name] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+try:
+    from skycomputing_tpu.serving import paging as _paging
+except Exception:  # pragma: no cover - exercised on bare CI runners
+    _paging = _load_by_path(
+        "_skytpu_chunk_smoke", "skycomputing_tpu", "serving", "paging.py"
+    )
+
+
+def check(cond, message):
+    if not cond:
+        print(f"FAIL: {message}")
+        raise SystemExit(1)
+    print(f"  ok: {message}")
+
+
+def main() -> int:
+    Policy = _paging.ChunkBudgetPolicy
+
+    print("decode-protecting budget:")
+    policy = Policy(32, max_chunk_rows=2, idle_chunk_rows=8)
+    check(policy.rows_for_tick(pending=0, decoding=4) == 0,
+          "no pending chunk work -> zero rows")
+    check(policy.rows_for_tick(pending=10, decoding=4) == 2,
+          "live decoders cap the tick at max_chunk_rows")
+    check(policy.rows_for_tick(pending=1, decoding=4) == 1,
+          "budget never exceeds pending work")
+    check(policy.starvation_bound_tokens() == 64,
+          "starvation bound = max_chunk_rows x prefill_chunk")
+
+    print("idle opening:")
+    check(policy.rows_for_tick(pending=10, decoding=0) == 8,
+          "nothing decoding -> the idle budget applies")
+    check(policy.rows_for_tick(pending=3, decoding=0) == 3,
+          "idle budget still never exceeds pending")
+    default = Policy(16)
+    check(default.max_chunk_rows == 1
+          and default.idle_chunk_rows >= default.max_chunk_rows,
+          "defaults: one row per busy tick, idle never tighter")
+
+    print("validation:")
+    for bad in (lambda: Policy(0),
+                lambda: Policy(16, max_chunk_rows=0),
+                lambda: Policy(16, max_chunk_rows=4, idle_chunk_rows=2)):
+        try:
+            bad()
+        except ValueError:
+            pass
+        else:
+            check(False, "invalid policy construction must raise")
+    check(True, "zero/negative knobs and idle < busy all rejected")
+
+    print("chunk-policy smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
