@@ -271,15 +271,29 @@ def _serving_kv_profile(
 
     ``serving``: either the SLOT operating point — ``slots`` +
     ``max_len`` (both required) — or the PAGED one — ``num_pages`` +
-    ``page_size`` (both required; the pool is ``num_pages x page_size``
-    positions, byte-identical formula) with optional
-    ``max_pages_per_request``; plus ``bucket`` (optional, reported in
-    diagnostics) and ``kv_mb_per_layer`` (optional explicit profile —
-    must match the model length; computed from the config via the
-    engine's own slab formula otherwise).
+    ``page_size`` (both required) with optional
+    ``max_pages_per_request`` and ``kv_dtype`` (the page pool's storage
+    dtype: ``"int8"`` charges the QUANTIZED byte width plus the
+    per-page-per-head scale slabs, through the allocator's own formula
+    ``serving/paging.paged_pool_mb`` — so the verifier can never
+    disagree with what the engine will actually allocate; absent =
+    the model dtype, byte-identical to the slot formula at equal
+    positions); plus ``bucket`` (optional, reported in diagnostics)
+    and ``kv_mb_per_layer`` (optional explicit profile — must match
+    the model length; computed from the config via the engine's own
+    slab formula otherwise).
     """
     severity = "error" if memory == "error" else "warning"
     paged = "num_pages" in serving or "page_size" in serving
+    kv_dtype = serving.get("kv_dtype")
+    if kv_dtype is not None and not paged:
+        issues.append(PlanIssue(
+            "memory", severity,
+            f"serving kv_dtype={kv_dtype!r} requires the paged "
+            f"operating point (num_pages/page_size) — slot slabs store "
+            f"the model dtype"
+        ))
+        return None
     if paged:
         try:
             slots = int(serving["num_pages"])
@@ -292,6 +306,17 @@ def _serving_kv_profile(
                 f"for page-pool memory"
             ))
             return None
+        if kv_dtype is not None:
+            from ..serving.paging import KV_DTYPE_ITEMSIZE
+
+            if str(kv_dtype) not in KV_DTYPE_ITEMSIZE:
+                issues.append(PlanIssue(
+                    "memory", severity,
+                    f"serving kv_dtype {kv_dtype!r} is not a known KV "
+                    f"storage dtype ({sorted(KV_DTYPE_ITEMSIZE)}) — "
+                    f"cannot account for page-pool memory"
+                ))
+                return None
     else:
         try:
             slots = int(serving["slots"])
@@ -333,6 +358,13 @@ def _serving_kv_profile(
                 f"{explicit!r}"
             ))
             return None
+    if paged:
+        from ..serving.kv_cache import paged_kv_mb_per_layer
+
+        return paged_kv_mb_per_layer(
+            model_cfg, slots, max_len,
+            kv_dtype=str(kv_dtype) if kv_dtype is not None else None,
+        )
     from ..serving.kv_cache import kv_mb_per_layer
 
     return kv_mb_per_layer(model_cfg, slots, max_len)
@@ -352,9 +384,11 @@ def _serving_label(serving: Dict) -> str:
             )
         except (TypeError, ValueError):
             span = f", {mpr!r} pages/request"
+        kvd = serving.get("kv_dtype")
+        quant = f", {kvd} pages + scale slabs" if kvd is not None else ""
         return (
             f"{int(serving['num_pages'])} KV pages x page_size "
-            f"{int(serving['page_size'])}{span}{tail}"
+            f"{int(serving['page_size'])}{span}{quant}{tail}"
         )
     return (
         f"{int(serving['slots'])} KV slots x max_len "
@@ -876,6 +910,16 @@ def _verify_serving_payload(serving: Any) -> List[str]:
         serving = dict(serving)
         if _pos_int(ps) and _pos_int(mpr):
             serving.setdefault("max_len", ps * mpr)
+        kvd = serving.get("kv_dtype")
+        if kvd is not None:
+            from ..serving.paging import KV_DTYPE_ITEMSIZE
+
+            if not isinstance(kvd, str) or kvd not in KV_DTYPE_ITEMSIZE:
+                problems.append(
+                    f"serving.kv_dtype {kvd!r} is not a known KV "
+                    f"storage dtype ({sorted(KV_DTYPE_ITEMSIZE)}) — "
+                    f"the page pool cannot be byte-accounted"
+                )
     else:
         for key in ("slots", "max_len"):
             v = serving.get(key)
@@ -884,6 +928,12 @@ def _verify_serving_payload(serving: Any) -> List[str]:
                     f"serving.{key} must be a positive int (KV slot "
                     f"pool shape), got {v!r}"
                 )
+        if serving.get("kv_dtype") is not None:
+            problems.append(
+                f"serving.kv_dtype {serving['kv_dtype']!r} requires "
+                f"the paged operating point — slot slabs store the "
+                f"model dtype"
+            )
     buckets = serving.get("buckets")
     if buckets is not None:
         if not isinstance(buckets, list) or not buckets:

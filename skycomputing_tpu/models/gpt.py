@@ -235,24 +235,40 @@ class GptBlock_Attn(nn.Module):
         return hidden + self.c_proj(ctx), k_cache, v_cache
 
     def decode_paged(
-        self, hidden, k_slab, v_slab, page_table, index, valid_len
+        self, hidden, k_slab, v_slab, page_table, index, valid_len,
+        attn_impl: str = "xla",
     ):
         """One incremental step against PAGED slabs (PagedAttention).
 
         ``hidden``: [R, Lq, H] new positions index..index+Lq-1 per row;
         ``k_slab``/``v_slab``: [num_pages, page_size, heads, head_dim]
-        physical page pools shared by every row; ``page_table``:
-        [R, max_pages] logical->physical map (sentinel-padded);
-        ``index``/``valid_len``: [R] per-row start and true end
-        positions (pad-tail writes drop; see
-        ``serving/kv_cache.paged_update_kv``).  Attention runs over the
-        gathered virtual view — logical position v of row r reads
-        page ``v // page_size`` at offset ``v % page_size`` — with the
-        same causal/staleness mask as the slot path, so the two layouts
-        share one visibility definition.  Returns
+        physical page pools shared by every row — plain arrays, or
+        ``serving/kv_cache.QuantizedPages`` (int8 values + scale slab);
+        ``page_table``: [R, table_width] logical->physical map
+        (sentinel-padded); ``index``/``valid_len``: [R] per-row start
+        and true end positions (pad-tail writes drop; see
+        ``serving/kv_cache.paged_update_kv``).
+
+        ``attn_impl`` picks the attention body behind one contract:
+
+        - ``"xla"`` (reference): gather the virtual per-row views
+          (materialized in HBM — cost scales with the TABLE width) and
+          run the masked float32 softmax, exactly the slot path's math;
+        - ``"pallas"``: the fused kernel (``ops/paged_attention.py``)
+          walks the page table inside the kernel, streaming pages
+          through online-softmax accumulation, so the virtual view
+          never materializes.  fp outputs agree with the reference to
+          float32 roundoff (greedy streams token-identical); int8 pages
+          dequantize in-kernel.
+
+        Both impls share the one visibility definition — logical
+        position v visible to query q iff ``v <= index + q`` — so a
+        sentinel-clamped or stale page reads as masked garbage exactly
+        like the slot layout's freed-row tail.  Returns
         (new_hidden, k_slab, v_slab).
         """
         from ..serving.kv_cache import (
+            QuantizedPages,
             decode_visibility,
             gather_kv_pages,
             paged_update_kv,
@@ -266,18 +282,38 @@ class GptBlock_Attn(nn.Module):
         k_slab, v_slab = paged_update_kv(
             k_slab, v_slab, k_new, v_new, page_table, index, valid_len
         )
-        k_virt, v_virt = gather_kv_pages(k_slab, v_slab, page_table)
+        if attn_impl == "pallas":
+            from ..ops.paged_attention import paged_attention
 
-        scores = jnp.einsum(
-            "blhd,bmhd->bhlm", q, k_virt.astype(dtype)
-        ) / jnp.sqrt(jnp.asarray(head_dim, dtype))
-        Lq, virt_len = q.shape[1], k_virt.shape[1]
-        visible = decode_visibility(index, Lq, virt_len)
-        scores = jnp.where(visible[:, None], scores, -jnp.inf)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
-            dtype
-        )
-        ctx = jnp.einsum("bhlm,bmhd->blhd", probs, v_virt.astype(dtype))
+            if isinstance(k_slab, QuantizedPages):
+                ctx = paged_attention(
+                    q, k_slab.values, v_slab.values, page_table, index,
+                    k_scale=k_slab.scale, v_scale=v_slab.scale,
+                )
+            else:
+                ctx = paged_attention(
+                    q, k_slab, v_slab, page_table, index
+                )
+            ctx = ctx.astype(dtype)
+        elif attn_impl == "xla":
+            k_virt, v_virt = gather_kv_pages(k_slab, v_slab, page_table)
+
+            scores = jnp.einsum(
+                "blhd,bmhd->bhlm", q, k_virt.astype(dtype)
+            ) / jnp.sqrt(jnp.asarray(head_dim, dtype))
+            Lq, virt_len = q.shape[1], k_virt.shape[1]
+            visible = decode_visibility(index, Lq, virt_len)
+            scores = jnp.where(visible[:, None], scores, -jnp.inf)
+            probs = jax.nn.softmax(
+                scores.astype(jnp.float32), axis=-1
+            ).astype(dtype)
+            ctx = jnp.einsum(
+                "bhlm,bmhd->blhd", probs, v_virt.astype(dtype)
+            )
+        else:
+            raise ValueError(
+                f"attn_impl must be 'xla' or 'pallas', got {attn_impl!r}"
+            )
         ctx = ctx.reshape(ctx.shape[0], ctx.shape[1], cfg.hidden_size)
         return hidden + self.c_proj(ctx), k_slab, v_slab
 
@@ -614,15 +650,19 @@ def apply_kv_cached(modules, params_list, data, caches, index):
 
 
 def apply_kv_paged(
-    modules, params_list, data, slabs, page_table, index, valid_len
+    modules, params_list, data, slabs, page_table, index, valid_len,
+    attn_impl: str = "xla",
 ):
     """Thread one PAGED decode step through a module slice — the paged
     twin of :func:`apply_kv_cached`.
 
     ``slabs`` is one ``[num_pages, page_size, heads, head_dim]`` (k, v)
-    pair per attention unit in the slice; ``page_table``/``index``/
-    ``valid_len`` are shared across the slice's layers (one logical
-    sequence per row, every layer caches it at the same positions).
+    pair per attention unit in the slice (plain arrays or
+    ``QuantizedPages``); ``page_table``/``index``/``valid_len`` are
+    shared across the slice's layers (one logical sequence per row,
+    every layer caches it at the same positions); ``attn_impl``
+    (``"xla"`` reference / ``"pallas"`` fused kernel) threads to every
+    attention unit — see :meth:`GptBlock_Attn.decode_paged`.
     Both prefill (``Lq = bucket``, ``index`` = per-row shared-prefix
     offsets) and decode (``Lq = 1``) are this one function at different
     input shapes, so the steady state compiles exactly one decode
@@ -650,7 +690,7 @@ def apply_kv_paged(
             k, v = new_slabs[cache_i]
             data, k, v = module.apply(
                 {"params": params}, data, k, v, page_table, index,
-                valid_len, method=GptBlock_Attn.decode_paged,
+                valid_len, attn_impl, method=GptBlock_Attn.decode_paged,
             )
             new_slabs[cache_i] = (k, v)
             cache_i += 1

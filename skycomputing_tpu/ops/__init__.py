@@ -1,5 +1,6 @@
 from .flash_attention import flash_attention
 from .losses import build_loss, causal_lm_loss, cross_entropy_loss, mse_loss
+from .paged_attention import paged_attention, paged_attention_reference
 from .metrics import (
     accuracy,
     compute_task_metrics,
@@ -13,6 +14,8 @@ __all__ = [
     "cross_entropy_loss",
     "mse_loss",
     "flash_attention",
+    "paged_attention",
+    "paged_attention_reference",
     "accuracy",
     "compute_task_metrics",
     "f1_score",

@@ -1,0 +1,311 @@
+"""Fused paged-attention decode kernel (PagedAttention's kernel half).
+
+PR 9 reproduced the *memory-management* half of PagedAttention (Kwon et
+al., SOSP '23): refcounted pages, per-request page tables, copy-on-write
+prefix sharing.  Its device math, though, still materialized each row's
+full virtual KV view in HBM every layer of every decode tick
+(``serving/kv_cache.gather_kv_pages``): a ``[R, table_width * page_size,
+heads, head_dim]`` gather whose cost scales with the TABLE width, not the
+tokens actually live.  This module is the kernel half: the page walk
+moves INSIDE a Pallas kernel, so the gathered view never exists —
+
+- grid ``(rows, heads, table_width)``: each program owns one (row, head)
+  pair's slice of one logical page; the page table rides scalar prefetch
+  (``pltpu.PrefetchScalarGridSpec``) so the K/V BlockSpec index maps
+  gather the right PHYSICAL page per grid step — one page-sized block
+  through VMEM at a time, the ``flash_attention.py`` streaming recipe
+  applied through an indirection table;
+- online softmax: running max / running sum / accumulator live in VMEM
+  scratch across the page dimension (initialized at page 0, emitted at
+  the last page), so the ``[Lq, positions]`` score matrix never hits HBM;
+- dead pages cost no math: a page wholly beyond a row's causal bound is
+  skipped with ``pl.when`` (its block DMA still issues — bounding the
+  TABLE width is the engine's job, see ``ServingEngine`` ``gather_pages``);
+- sentinel table entries (``>= num_pages``, the pool's padding) clamp to
+  a real page and are masked by the same causal rule that masks a slot
+  row's stale tail — by the pool's covering invariant a sentinel only
+  ever appears past the row's live span;
+- int8 pages dequantize in-kernel: ``k/v_scale`` are the pool's
+  per-page-per-head scale slabs (``serving/kv_cache.QuantizedPages``),
+  fetched as (1, 1) blocks by the same table indirection and multiplied
+  into the block after the int8 load — the quantized pool never takes an
+  HBM-side dequantized copy either.
+
+Off-TPU the kernel runs in interpret mode (the ``flash_attention.py``
+convention), which is how the CPU suite pins it against the XLA
+reference; ``attn_impl="pallas"`` on a CPU engine is therefore a
+correctness surface, not a fast path — the compiled kernel needs a TPU.
+
+Layer discipline: this module speaks raw arrays only (q, slabs, tables,
+scales) — the serving package's pool/grant types stay out of ``ops``;
+``models/gpt.decode_paged`` unpacks them before calling in.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# Guarded: the TPU-flavored Pallas namespace (scalar prefetch, VMEM
+# scratch) is packaged with jax but has seen import-time breakage on
+# exotic CPU-only builds; collection of this module must never die for
+# it.  Callers get a precise error only when the kernel is actually
+# invoked without it.
+try:  # pragma: no cover - import guard
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover - exercised on broken builds only
+    pltpu = None
+
+
+def _require_pltpu():
+    if pltpu is None:  # pragma: no cover - broken-build path
+        raise RuntimeError(
+            "jax.experimental.pallas.tpu failed to import on this build; "
+            "the fused paged-attention kernel is unavailable — use "
+            "attn_impl='xla' (the reference path)"
+        )
+
+
+def _paged_kernel(table_ref, idx_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_ref, l_ref, acc_ref, *, page_size: int,
+                  softmax_scale: float):
+    """fp kernel body: one (row, head, logical page) grid cell."""
+    r = pl.program_id(0)
+    i = pl.program_id(2)
+    Lq = q_ref.shape[1]
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    idx = idx_ref[r]
+
+    # page i spans positions [i*ps, (i+1)*ps); the row's last query sits
+    # at idx + Lq - 1, so later pages hold nothing visible — skipping
+    # them also keeps a fully-masked block from feeding exp(-inf+inf)
+    # NaNs into the running max
+    @pl.when(i * page_size <= idx + Lq - 1)
+    def _page():
+        q = q_ref[0, :, 0, :].astype(jnp.float32) * softmax_scale
+        k = k_ref[0, :, 0, :].astype(jnp.float32)
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        _accumulate(q, k, v, idx, i, page_size, Lq,
+                    m_ref, l_ref, acc_ref)
+
+    @pl.when(i == pl.num_programs(2) - 1)
+    def _emit():
+        o_ref[0, :, 0, :] = (
+            acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def _paged_kernel_int8(table_ref, idx_ref, q_ref, k_ref, v_ref,
+                       ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                       page_size: int, softmax_scale: float):
+    """int8 kernel body: dequantize the page block with its
+    per-page-per-head scale right after the load."""
+    r = pl.program_id(0)
+    i = pl.program_id(2)
+    Lq = q_ref.shape[1]
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    idx = idx_ref[r]
+
+    @pl.when(i * page_size <= idx + Lq - 1)
+    def _page():
+        q = q_ref[0, :, 0, :].astype(jnp.float32) * softmax_scale
+        k = k_ref[0, :, 0, :].astype(jnp.float32) * ks_ref[0, 0]
+        v = v_ref[0, :, 0, :].astype(jnp.float32) * vs_ref[0, 0]
+        _accumulate(q, k, v, idx, i, page_size, Lq,
+                    m_ref, l_ref, acc_ref)
+
+    @pl.when(i == pl.num_programs(2) - 1)
+    def _emit():
+        o_ref[0, :, 0, :] = (
+            acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def _accumulate(q, k, v, idx, i, page_size, Lq, m_ref, l_ref, acc_ref):
+    """One online-softmax block step (the flash_attention.py inner
+    body, with the causal mask phrased in LOGICAL page positions)."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [Lq, page_size]
+    pos = i * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, (Lq, page_size), 1
+    )
+    qpos = idx + jax.lax.broadcasted_iota(
+        jnp.int32, (Lq, page_size), 0
+    )
+    s = jnp.where(pos <= qpos, s, -jnp.inf)
+    m_prev = m_ref[...]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m_prev - m_new)
+    l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[...] = m_new
+
+
+def paged_attention(
+    q,
+    k_pages,
+    v_pages,
+    page_table,
+    index,
+    *,
+    k_scale=None,
+    v_scale=None,
+    softmax_scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+):
+    """Fused attention over paged KV, table walk inside the kernel.
+
+    ``q``: [R, Lq, H, D] query block (``Lq = 1`` decode, ``Lq = k + 1``
+    speculative verify); ``k_pages``/``v_pages``: [num_pages, page_size,
+    H, D] physical page pools — fp, or int8 with ``k_scale``/``v_scale``
+    [num_pages, H] per-page-per-head dequant scales; ``page_table``:
+    [R, table_width] int32 logical->physical, sentinel-padded
+    (``>= num_pages`` entries clamp and are causally masked);
+    ``index``: [R] (or scalar) position of each row's FIRST query —
+    query ``j`` sits at ``index + j`` and sees positions ``<= index + j``.
+
+    Returns the attention context [R, Lq, H, D] in ``q``'s dtype.  The
+    math is the XLA reference's (``float32`` softmax, same causal/
+    staleness mask) restructured as online softmax, so fp outputs agree
+    to float32 roundoff and greedy decode streams are token-identical.
+    """
+    _require_pltpu()
+    R, Lq, H, D = q.shape
+    num_pages, page_size = k_pages.shape[0], k_pages.shape[1]
+    table_width = page_table.shape[1]
+    if softmax_scale is None:
+        softmax_scale = float(D) ** -0.5
+    if interpret is None:
+        # the flash_attention.py convention: same code path everywhere,
+        # compiled on TPU, interpreted (slow but exact) off it
+        interpret = jax.default_backend() != "tpu"
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("pass k_scale AND v_scale together (int8) "
+                         "or neither (fp)")
+
+    idx = jnp.broadcast_to(
+        jnp.reshape(jnp.asarray(index, jnp.int32), (-1,)), (R,)
+    )
+    table = jnp.asarray(page_table, jnp.int32)
+
+    def q_map(r, h, i, table_ref, idx_ref):
+        return (r, 0, h, 0)
+
+    def kv_map(r, h, i, table_ref, idx_ref):
+        # sentinel entries clamp into the pool; their positions are past
+        # the row's causal bound by the pool's covering invariant, so
+        # the mask (not the clamp target) is what keeps them inert
+        return (jnp.minimum(table_ref[r, i], num_pages - 1), 0, h, 0)
+
+    def scale_map(r, h, i, table_ref, idx_ref):
+        return (jnp.minimum(table_ref[r, i], num_pages - 1), h)
+
+    in_specs = [
+        pl.BlockSpec((1, Lq, 1, D), q_map),
+        pl.BlockSpec((1, page_size, 1, D), kv_map),
+        pl.BlockSpec((1, page_size, 1, D), kv_map),
+    ]
+    operands = [q, k_pages, v_pages]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, 1), scale_map),
+            pl.BlockSpec((1, 1), scale_map),
+        ]
+        operands += [k_scale, v_scale]
+        body = functools.partial(
+            _paged_kernel_int8, page_size=page_size,
+            softmax_scale=softmax_scale,
+        )
+    else:
+        body = functools.partial(
+            _paged_kernel, page_size=page_size,
+            softmax_scale=softmax_scale,
+        )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(R, H, table_width),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, Lq, 1, D), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((Lq, 1), jnp.float32),  # running max
+            pltpu.VMEM((Lq, 1), jnp.float32),  # running sum
+            pltpu.VMEM((Lq, D), jnp.float32),  # output accumulator
+        ],
+    )
+    return pl.pallas_call(
+        body,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((R, Lq, H, D), q.dtype),
+        interpret=interpret,
+    )(table, idx, *operands)
+
+
+def paged_attention_reference(
+    q, k_pages, v_pages, page_table, index, *,
+    k_scale=None, v_scale=None, softmax_scale: Optional[float] = None,
+):
+    """Plain-XLA reference with the kernel's exact contract: gather the
+    virtual views (materialized — the cost the kernel removes), mask,
+    float32 softmax.  The correctness anchor for the kernel tests and
+    the CI smoke; the serving engine's ``attn_impl="xla"`` path computes
+    the same thing through ``serving/kv_cache.gather_kv_pages``."""
+    R, Lq, H, D = q.shape
+    num_pages, page_size = k_pages.shape[0], k_pages.shape[1]
+    if softmax_scale is None:
+        softmax_scale = float(D) ** -0.5
+    idx = jnp.broadcast_to(
+        jnp.reshape(jnp.asarray(index, jnp.int32), (-1,)), (R,)
+    )
+    pos = (
+        jnp.asarray(page_table, jnp.int32)[:, :, None] * page_size
+        + jnp.arange(page_size, dtype=jnp.int32)[None, None, :]
+    )
+    flat_pos = jnp.clip(pos.reshape(R, -1), 0, num_pages * page_size - 1)
+
+    def gather(slab, scale):
+        flat = slab.reshape((num_pages * page_size,) + slab.shape[2:])
+        out = flat[flat_pos].astype(jnp.float32)
+        if scale is not None:
+            page_of = flat_pos // page_size
+            out = out * scale[page_of][:, :, :, None]
+        return out
+
+    k_virt = gather(k_pages, k_scale)  # [R, W*ps, H, D]
+    v_virt = gather(v_pages, v_scale)
+    s = jnp.einsum(
+        "blhd,bmhd->bhlm", q.astype(jnp.float32) * softmax_scale, k_virt
+    )
+    virt_len = k_virt.shape[1]
+    qpos = idx[:, None] + jnp.arange(Lq, dtype=jnp.int32)
+    kpos = jnp.arange(virt_len, dtype=jnp.int32)
+    visible = kpos[None, None, :] <= qpos[:, :, None]
+    s = jnp.where(visible[:, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhlm,bmhd->blhd", p, v_virt).astype(q.dtype)
+
+
+__all__ = ["paged_attention", "paged_attention_reference"]

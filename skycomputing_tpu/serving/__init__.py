@@ -8,7 +8,11 @@ this package is the serving side the ROADMAP's north star demands:
   slabs (fixed ``[slots, max_len, heads, head_dim]``, also backing
   ``models/gpt.py``'s single-request decoder) and paged pools
   (``[num_pages, page_size, heads, head_dim]`` gather/scatter through
-  page tables), donation-friendly in-place updates throughout;
+  page tables; :class:`QuantizedPages` stores them int8 with
+  per-page-per-head scale slabs, quantized at write time), donation-
+  friendly in-place updates throughout — the fused decode kernel that
+  walks page tables in-kernel lives in ``ops/paged_attention.py`` and
+  is engine-selected via ``attn_impl=``;
 - :mod:`.paging` — the paged host bookkeeping (pure stdlib):
   free-list page allocator with refcounts and copy-on-write grants,
   radix prefix index for compute-once shared prompts, decode-row
@@ -44,6 +48,7 @@ from .batcher import (
 from .engine import ServingEngine, ServingStats
 from .kv_cache import (
     KVCacheSpec,
+    QuantizedPages,
     SlotKVCachePool,
     gather_kv_pages,
     init_layer_caches,
@@ -52,15 +57,19 @@ from .kv_cache import (
     kv_spec_from_config,
     paged_kv_mb_per_layer,
     paged_update_kv,
+    quantize_pages,
     update_kv_cache,
 )
 from .paging import (
     ChunkBudgetPolicy,
+    KV_DTYPE_ITEMSIZE,
     PagedKVCachePool,
     RadixPrefixIndex,
     RowAllocator,
     choose_preempt_mode,
+    paged_pool_mb,
     pages_for,
+    pages_per_mb,
 )
 from .profile import DecodeModelBenchmarker
 from .speculative import DraftModel, greedy_accept_count
@@ -71,7 +80,9 @@ __all__ = [
     "DecodeModelBenchmarker",
     "DraftModel",
     "KVCacheSpec",
+    "KV_DTYPE_ITEMSIZE",
     "PagedKVCachePool",
+    "QuantizedPages",
     "QueueFullError",
     "RadixPrefixIndex",
     "Request",
@@ -88,7 +99,10 @@ __all__ = [
     "kv_mb_per_layer",
     "kv_spec_from_config",
     "paged_kv_mb_per_layer",
+    "paged_pool_mb",
     "paged_update_kv",
     "pages_for",
+    "pages_per_mb",
+    "quantize_pages",
     "update_kv_cache",
 ]

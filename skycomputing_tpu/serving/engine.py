@@ -25,7 +25,14 @@ implemented here:
   swap-preemption — in ``serving/paging.py``), so admission charges a
   request its TRUE footprint in pages and concurrency floats with
   memory instead of a slot count (>2x sustained at equal pool MB,
-  gated in ``BENCH_serving.json``).  Either way every compiled program
+  gated in ``BENCH_serving.json``).  The paged decode path picks its
+  attention body per engine (``attn_impl``: the fused Pallas kernel on
+  TPU, the XLA gather+softmax reference elsewhere), bounds each step's
+  page-table width to the wave's live span (``gather_pages="live"`` —
+  the table-capacity-proportional gather was PR 9's raw speed floor),
+  and can store pages int8 with per-page-per-head scale slabs
+  (``kv_dtype="int8"`` — ~4x pages per MB at fp32 model dtype, bounded
+  error; see docs/serving.md).  Either way every compiled program
   keeps a fixed shape regardless of which requests are live: decode
   compiles ONCE; prefill compiles once per prompt-length bucket
   (``serving/batcher.py``); after warmup the steady state is
@@ -81,6 +88,7 @@ from .batcher import (
     ShapeBucketer,
 )
 from .kv_cache import (
+    QuantizedPages,
     SlotKVCachePool,
     init_paged_caches,
     kv_spec_from_config,
@@ -172,6 +180,14 @@ class ServingStats:
     # prefill_chunk, or accept the TTFT cost)
     prefill_chunks: int = 0
     chunk_stalls: int = 0
+    # int8-KV accounting (kv_dtype="int8"): quantized_pages counts
+    # page-tile quantization events (every page a write wave touched
+    # re-quantizes through its scale — write amplification made
+    # visible); dequant_blocks counts page blocks dequantized by
+    # attention reads (active rows x gathered table width per step —
+    # the work the bounded gather and the fused kernel shrink)
+    quantized_pages: int = 0
+    dequant_blocks: int = 0
     # speculative-decoding accounting (spec_k > 0): draft_tokens =
     # USABLE draft proposals (capped at each row's remaining token
     # budget — surplus drafts a row could never commit don't deflate
@@ -215,6 +231,7 @@ class ServingStats:
         "cow_copies": "counter", "swap_outs": "counter",
         "swap_ins": "counter", "prefix_evictions": "counter",
         "prefill_chunks": "counter", "chunk_stalls": "counter",
+        "quantized_pages": "counter", "dequant_blocks": "counter",
         "draft_tokens": "counter",
         "accepted_draft_tokens": "counter",
         "spec_rollbacks": "counter",
@@ -261,6 +278,8 @@ class ServingStats:
             prefix_evictions=self.prefix_evictions,
             prefill_chunks=self.prefill_chunks,
             chunk_stalls=self.chunk_stalls,
+            quantized_pages=self.quantized_pages,
+            dequant_blocks=self.dequant_blocks,
             draft_tokens=self.draft_tokens,
             accepted_draft_tokens=self.accepted_draft_tokens,
             spec_rollbacks=self.spec_rollbacks,
@@ -411,6 +430,8 @@ class _PagedServingStage:
         num_pages: int,
         page_size: int,
         program_key: Optional[str] = None,
+        kv_dtype: Optional[str] = None,
+        attn_impl: str = "xla",
     ):
         self.stage_index = stage_index
         self.modules = list(modules)
@@ -419,6 +440,8 @@ class _PagedServingStage:
         self.params: List[Any] = jax.device_put(list(params), device)
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
+        self.kv_dtype = kv_dtype
+        self.attn_impl = attn_impl
         self.specs = [
             kv_spec_from_config(
                 _gcfg(self.modules[i].config).to_dict(), page_size
@@ -434,10 +457,12 @@ class _PagedServingStage:
             self._step_donated = cached
             return
         mods = self.modules
+        impl = attn_impl
 
         def step(params_list, data, slabs, tables, index, valid_len):
             return apply_kv_paged(
-                mods, params_list, data, slabs, tables, index, valid_len
+                mods, params_list, data, slabs, tables, index,
+                valid_len, attn_impl=impl,
             )
 
         if _donation_enabled():
@@ -450,42 +475,91 @@ class _PagedServingStage:
     def build_slabs(self, num_pages: int, page_size: int):
         """Fresh zeroed page slabs (construction + the reconfigure
         pre-build, so an allocation failure surfaces while the engine
-        is still intact)."""
+        is still intact).  ``kv_dtype="int8"`` builds QuantizedPages
+        pairs: int8 values + the parallel float32 scale slabs."""
         return init_paged_caches(
-            self.specs, num_pages, page_size, device=self.device
+            self.specs, num_pages, page_size, device=self.device,
+            kv_dtype=self.kv_dtype,
         )
 
-    def cow_copy(self, src: int, dst: int) -> None:
-        """Clone physical page ``src`` into ``dst`` across every layer
-        (the grant's copy-on-write step: the donor's partial page
-        becomes the sharer's private page before any append)."""
-        s = np.int32(src)
-        d = np.int32(dst)
-        self.slabs = [
-            (_copy_page(k, s, d), _copy_page(v, s, d))
-            for k, v in self.slabs
-        ]
+    def apply_cow_plan(self, plan) -> None:
+        """Execute the pool's copy-on-write plan
+        (``PagedKVCachePool.cow_plan``) against this stage's slabs —
+        the plan, not this method, is the source of truth for WHAT a
+        clone copies: on an int8 pool it names the scale row alongside
+        the values (a cloned page dequantized with the donor's scale
+        but re-scaled under its new owner would corrupt the shared
+        prefix).  A plan/slab mismatch — a scale copy planned for a
+        pool whose slabs are not quantized, or vice versa — raises:
+        that is kv_dtype drift between the allocator and the device
+        slabs, never something to paper over."""
+        copies: Dict[str, Any] = {}
+        for kind, src, dst in plan:
+            if kind not in ("values", "scales"):
+                raise ValueError(f"unknown COW plan entry {kind!r}")
+            copies[kind] = (np.int32(src), np.int32(dst))
+        if not copies:
+            return
+
+        def cp(slab):
+            quantized = isinstance(slab, QuantizedPages)
+            if "scales" in copies and not quantized:
+                raise ValueError(
+                    "COW plan names a scale copy but this stage's "
+                    "slabs are not quantized — pool/stage kv_dtype "
+                    "drift"
+                )
+            if not quantized:
+                s, d = copies["values"]
+                return _copy_page(slab, s, d)
+            values, scale = slab.values, slab.scale
+            if "values" in copies:
+                s, d = copies["values"]
+                values = _copy_page(values, s, d)
+            if "scales" in copies:
+                s, d = copies["scales"]
+                scale = _copy_page(scale, s, d)
+            return QuantizedPages(values, scale)
+
+        # one pass over the slab list regardless of how many entry
+        # kinds the plan carries (values + scales copy together)
+        self.slabs = [(cp(k), cp(v)) for k, v in self.slabs]
 
     def swap_out(self, table: np.ndarray) -> List[Any]:
         """Host copies of the pages in ``table`` (sentinel-padded, so
         the gathered shape is fixed at [max_pages, page_size, ...] and
         compiles once); sentinel rows carry garbage the swap-in scatter
-        drops."""
+        drops.  int8 slabs swap their scale rows alongside the values —
+        a page restored without its scale would dequantize garbage."""
         t = jnp.asarray(table, jnp.int32)
-        return [
-            (np.asarray(_gather_rows(k, t)), np.asarray(_gather_rows(v, t)))
-            for k, v in self.slabs
-        ]
+
+        def g(slab):
+            if isinstance(slab, QuantizedPages):
+                return QuantizedPages(
+                    np.asarray(_gather_rows(slab.values, t)),
+                    np.asarray(_gather_rows(slab.scale, t)),
+                )
+            return np.asarray(_gather_rows(slab, t))
+
+        return [(g(k), g(v)) for k, v in self.slabs]
 
     def swap_in(self, table: np.ndarray, host_pairs: List[Any]) -> None:
         """Scatter host page copies back into fresh pages (sentinel
         table rows drop)."""
         t = jnp.asarray(table, jnp.int32)
+
+        def s(slab, host):
+            if isinstance(slab, QuantizedPages):
+                return QuantizedPages(
+                    _scatter_rows(slab.values, t,
+                                  jnp.asarray(host.values)),
+                    _scatter_rows(slab.scale, t,
+                                  jnp.asarray(host.scale)),
+                )
+            return _scatter_rows(slab, t, jnp.asarray(host))
+
         self.slabs = [
-            (
-                _scatter_rows(k, t, jnp.asarray(hk)),
-                _scatter_rows(v, t, jnp.asarray(hv)),
-            )
+            (s(k, hk), s(v, hv))
             for (k, v), (hk, hv) in zip(self.slabs, host_pairs)
         ]
 
@@ -531,6 +605,9 @@ class ServingEngine(LiveMetricsMixin):
         max_chunk_rows: Optional[int] = None,
         spec_k: int = 0,
         draft_blocks: Optional[int] = None,
+        kv_dtype: Optional[str] = None,
+        attn_impl: Optional[str] = None,
+        gather_pages: str = "live",
     ):
         if kv_layout not in ("slot", "paged"):
             raise ValueError(
@@ -543,6 +620,53 @@ class ServingEngine(LiveMetricsMixin):
             )
         self.kv_layout = kv_layout
         self._paged = kv_layout == "paged"
+        # --- the paged kernel/quantization operating point ------------
+        # kv_dtype: None keeps the model dtype; "int8" stores pages
+        # quantized (per-page-per-head scale slabs, quantize-on-write)
+        # — construction state like draft_blocks, NOT a reconfigure
+        # knob: a dtype flip would have to re-encode every live page.
+        # attn_impl: None auto-detects — the fused Pallas kernel on a
+        # TPU backend, the XLA reference elsewhere (interpret-mode
+        # Pallas is available everywhere but is a correctness surface,
+        # ~orders slower than XLA on CPU; pass "pallas" explicitly to
+        # use it off-TPU).  gather_pages: "live" bounds every step's
+        # page-table width to the wave's live span (ceil to page, then
+        # to the next power-of-two page count with the largest bucket
+        # as floor — a log-sized compile-shape set, each warmed like a
+        # prefill bucket); "full" keeps PR 9's full-table-width gather,
+        # the honest A/B baseline the bench measures against.
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None (model dtype) or 'int8', "
+                f"got {kv_dtype!r}"
+            )
+        if attn_impl not in (None, "xla", "pallas"):
+            raise ValueError(
+                f"attn_impl must be None (auto), 'xla' or 'pallas', "
+                f"got {attn_impl!r}"
+            )
+        if gather_pages not in ("live", "full"):
+            raise ValueError(
+                f"gather_pages must be 'live' or 'full', "
+                f"got {gather_pages!r}"
+            )
+        if not self._paged and (kv_dtype is not None
+                                or attn_impl is not None
+                                or gather_pages != "live"):
+            raise ValueError(
+                "kv_dtype/attn_impl/gather_pages require "
+                "kv_layout='paged' (the kernel, the quantized pool, "
+                "and the bounded table gather are page-table "
+                "machinery)"
+            )
+        self.kv_dtype = kv_dtype
+        self.gather_pages = gather_pages
+        if self._paged:
+            self.attn_impl = attn_impl or (
+                "pallas" if jax.default_backend() == "tpu" else "xla"
+            )
+        else:
+            self.attn_impl = None
         modules = decode_modules(build_layer_stack(list(model_cfg)))
         if not attn_indices(modules) or not isinstance(
             modules[0], GptEmbeddings
@@ -744,6 +868,7 @@ class ServingEngine(LiveMetricsMixin):
                 self.max_pages_per_request,
                 enable_prefix_cache=self.enable_prefix_cache,
                 max_prefix_entries=self._max_prefix_entries,
+                kv_dtype=self._pool_kv_dtype(),
             )
             self._rows = RowAllocator(self.max_concurrency)
             # request_id -> host page copies + resume state (swap pool)
@@ -768,7 +893,8 @@ class ServingEngine(LiveMetricsMixin):
             # already, not closure identity)
             program_key = json.dumps(
                 [self._model_cfg[cursor:cursor + n], self.kv_layout,
-                 self.max_len, bool(_donation_enabled())],
+                 self.max_len, bool(_donation_enabled()),
+                 self.kv_dtype, self.attn_impl],
                 sort_keys=True, default=str,
             )
             if self._paged:
@@ -780,6 +906,8 @@ class ServingEngine(LiveMetricsMixin):
                     self.num_pages,
                     self.page_size,
                     program_key=program_key,
+                    kv_dtype=self.kv_dtype,
+                    attn_impl=self.attn_impl,
                 )
                 stage.pool = self._rows  # shared row ledger facade
             else:
@@ -801,6 +929,14 @@ class ServingEngine(LiveMetricsMixin):
             # pre-stage estimate above used the same head params)
             self._draft_mb = self._draft.extra_param_mb
 
+    def _pool_kv_dtype(self) -> str:
+        """The page pool's storage dtype string: the quantization knob
+        when set, else the model dtype — what the allocator accounts
+        and the verifier charges (one formula, paging.paged_pool_mb)."""
+        if self.kv_dtype is not None:
+            return self.kv_dtype
+        return str(_gcfg(self._model_cfg[0]["config"]).dtype)
+
     def _serving_context(self) -> Dict[str, Any]:
         """The operating point the pre-flight verifier charges."""
         if self._paged:
@@ -809,6 +945,11 @@ class ServingEngine(LiveMetricsMixin):
                 max_pages_per_request=self.max_pages_per_request,
                 bucket=self.bucketer.max_bucket,
             )
+            if self.kv_dtype is not None:
+                # the quantized byte width (+ scale slabs) is what the
+                # slabs will actually allocate — the verifier must
+                # charge the same formula or the two could disagree
+                ctx["kv_dtype"] = self.kv_dtype
             if self._draft_mb:
                 # the speculative draft's head copy is real stage-0
                 # residency — the verifier must see it
@@ -866,7 +1007,8 @@ class ServingEngine(LiveMetricsMixin):
             head_params = stage0.params[-1]
             extra_mb = 0.0
         key = DraftModel.program_key(
-            [self._model_cfg[i] for i in idx], self.max_len
+            [self._model_cfg[i] for i in idx], self.max_len,
+            attn_impl=self.attn_impl, kv_dtype=self.kv_dtype,
         )
         return DraftModel(
             list(stage0.modules[:cut]) + [head_module],
@@ -874,6 +1016,7 @@ class ServingEngine(LiveMetricsMixin):
             stage0.device,
             extra_param_mb=extra_mb,
             program_key=key,
+            attn_impl=self.attn_impl,
         )
 
     def _pending_draft_mb(self) -> float:
@@ -1728,6 +1871,8 @@ class ServingEngine(LiveMetricsMixin):
             ctx = dict(num_pages=charged, page_size=new_psize,
                        max_pages_per_request=new_mpr,
                        bucket=max(new_buckets))
+            if self.kv_dtype is not None:
+                ctx["kv_dtype"] = self.kv_dtype
             if charged_draft_mb > 0:
                 ctx["draft_mb"] = charged_draft_mb
             verify_plan(
@@ -1774,6 +1919,7 @@ class ServingEngine(LiveMetricsMixin):
                 new_pages, new_psize, new_mpr,
                 enable_prefix_cache=self.enable_prefix_cache,
                 max_prefix_entries=self._max_prefix_entries,
+                kv_dtype=self._pool_kv_dtype(),
             )
             if geometry_change else None
         )
@@ -1946,6 +2092,10 @@ class ServingEngine(LiveMetricsMixin):
                 pages_in_use=self._pool.pages_in_use,
                 swapped=len(self._swapped),
                 prefilling=len(self._prefilling),
+                # the active kernel/quantization operating point, so a
+                # scrape can tell WHICH decode path a replica runs
+                kv_dtype=self._pool.kv_dtype,
+                attn_impl=self.attn_impl,
             )
         return snap
 
@@ -2162,10 +2312,13 @@ class ServingEngine(LiveMetricsMixin):
         assert row is not None  # caller checked free rows
         request.slot = row
         # COW before any chunk write: the donor's partial page becomes
-        # this request's private page (same rule as the one-shot wave)
-        if grant.cow_src is not None:
+        # this request's private page (same rule as the one-shot wave);
+        # the pool's plan decides what a clone copies (scale rows ride
+        # along on an int8 pool)
+        plan = self._pool.cow_plan(grant)
+        if plan:
             for st in self.stages:
-                st.cow_copy(grant.cow_src, grant.cow_dst)
+                st.apply_cow_plan(plan)
         self._queue.remove(request)
         request.prefilled_len = grant.shared_tokens
         request.status = RUNNING
@@ -2273,6 +2426,9 @@ class ServingEngine(LiveMetricsMixin):
             index[i] = r.prefilled_len
             valid[i] = r.prefilled_len + int(chunks[i].size)
 
+        width = self._table_width(valid)
+        tables = tables[:, :width]
+        self._count_quant(index, valid, width, len(wave))
         tracer = get_tracer()
         span0 = tracer.now() if tracer is not None else 0.0
         t0 = time.perf_counter()
@@ -2451,12 +2607,18 @@ class ServingEngine(LiveMetricsMixin):
             valid[i] = g.shared_tokens + int(tails[i].size)
         # copy-on-write BEFORE any dispatch touches the slabs: the
         # donor's partial page becomes the sharer's private page, so
-        # the tail prefill's appends never write a shared page
+        # the tail prefill's appends never write a shared page; the
+        # pool's plan decides what a clone copies (scale rows ride
+        # along on an int8 pool)
         for _, g in wave:
-            if g.cow_src is not None:
+            plan = self._pool.cow_plan(g)
+            if plan:
                 for st in self.stages:
-                    st.cow_copy(g.cow_src, g.cow_dst)
+                    st.apply_cow_plan(plan)
 
+        width = self._table_width(valid)
+        tables = tables[:, :width]
+        self._count_quant(index, valid, width, len(wave))
         tracer = get_tracer()
         span0 = tracer.now() if tracer is not None else 0.0
         t0 = time.perf_counter()
@@ -2526,6 +2688,46 @@ class ServingEngine(LiveMetricsMixin):
             self.stats.generated_tokens += 1
             if r.done:
                 self._finish(r, now)
+
+    def _table_width(self, valid) -> int:
+        """Page-table columns this step actually needs (the PR 12
+        honest-gather fix): the wave's max live length, ceiled to a
+        page, then to the next power-of-two page count with the largest
+        bucket's span as floor — so the XLA reference gathers (and the
+        kernel's grid walks) O(live tokens), not O(max_pages), while
+        the distinct compile-shape set stays logarithmic and warmable
+        exactly like prefill buckets.  ``gather_pages="full"`` keeps
+        the PR 9 behavior: the full table width every step (the
+        materializing baseline the bench A/Bs against)."""
+        if self.gather_pages == "full":
+            return self.max_pages_per_request
+        need = max(1, pages_for(int(np.max(valid)), self.page_size))
+        floor = pages_for(self.bucketer.max_bucket, self.page_size)
+        width = max(need, floor)
+        p = 1
+        while p < width:
+            p <<= 1
+        return min(p, self.max_pages_per_request)
+
+    def _count_quant(self, index, valid, width: int, rows: int) -> None:
+        """int8 observability: bank this step's quantize/dequant work.
+        ``quantized_pages`` = pages the write wave touched (each one
+        re-quantized through its scale); ``dequant_blocks`` = page
+        blocks attention dequantized (active rows x gathered width) —
+        both per step, across all stages' layers would just scale by a
+        constant, so the per-step count is the honest unit."""
+        if self.kv_dtype != "int8":
+            return
+        index = np.asarray(index)
+        valid = np.asarray(valid)
+        live = valid > index
+        if np.any(live):
+            touched = (
+                (valid[live] - 1) // self.page_size
+                - index[live] // self.page_size + 1
+            )
+            self.stats.quantized_pages += int(touched.sum())
+        self.stats.dequant_blocks += int(rows) * int(width)
 
     def _run_paged_stages(self, data, tables, index, valid, tracer,
                           span_name, span_args=None):
@@ -2612,6 +2814,9 @@ class ServingEngine(LiveMetricsMixin):
             held = self._pool.table(r.request_id)
             tables[r.slot, : len(held)] = held
 
+        width = self._table_width(valid)
+        tables = tables[:, :width]
+        self._count_quant(index, valid, width, len(active))
         tracer = get_tracer()
         span0 = tracer.now() if tracer is not None else 0.0
         t0 = time.perf_counter()
@@ -2690,6 +2895,22 @@ class ServingEngine(LiveMetricsMixin):
             held = self._pool.table(r.request_id)
             tables[r.slot, : len(held)] = held
 
+        # verify writes cap at min(index+k+1, reserve); one table width
+        # (covering that bound) serves BOTH the draft loop and the
+        # verify forward, so the two stay on one warmed shape set
+        valid = np.minimum(index0 + k + 1, reserve)
+        width = self._table_width(valid)
+        tables = tables[:, :width]
+        self._count_quant(index0, valid, width, len(active))
+        if self.kv_dtype == "int8":
+            # the draft's k Lq=1 passes also quantize (one tail-page
+            # re-quant per kept step per row) and dequantize (one
+            # gathered width per step) — the verify-only count above
+            # would hide roughly half a spec tick's quantization work
+            slots = [r.slot for r in active]
+            kept = np.clip(reserve[slots] - index0[slots], 0, k)
+            self.stats.quantized_pages += int(kept.sum())
+            self.stats.dequant_blocks += k * len(active) * width
         tracer = get_tracer()
         span0 = tracer.now() if tracer is not None else 0.0
         t0 = time.perf_counter()
@@ -2719,7 +2940,6 @@ class ServingEngine(LiveMetricsMixin):
         # --- verify: one Lq=k+1 forward over the whole pipeline
         verify_span0 = tracer.now() if tracer is not None else 0.0
         verify_in = np.concatenate([tokens[:, None], drafted], axis=1)
-        valid = np.minimum(index0 + k + 1, reserve)
         logits3 = self._run_paged_stages(
             verify_in, tables, index0, valid, tracer, "decode"
         )  # [rows, k+1, V]

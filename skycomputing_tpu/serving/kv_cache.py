@@ -33,7 +33,7 @@ way around.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional, Sequence, Tuple
+from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -106,6 +106,121 @@ def decode_positions(index, query_len: int):
 # --------------------------------------------------------------------------
 
 
+class QuantizedPages(NamedTuple):
+    """An int8 page slab with its per-page-per-head dequant scales.
+
+    ``values``: [num_pages, page_size, heads, head_dim] int8;
+    ``scale``: [num_pages, heads] float32 — the parallel *scale slab*.
+    One symmetric amax scale covers a (page, head) tile: dequantized
+    value = ``values * scale``.  A NamedTuple so it rides jit/pytree
+    plumbing (donation, device_put, scatter/gather helpers) exactly
+    like a plain slab array; every paged-math entry point here
+    dispatches on this type, so ``kv_dtype="int8"`` changes no caller
+    signatures.
+    """
+
+    values: jax.Array
+    scale: jax.Array
+
+
+def quantize_pages(values, scale_hint=None):
+    """Symmetric per-page-per-head int8 quantization of a page-shaped
+    fp array [..., page_size, heads, head_dim] -> (int8, scale[...,
+    heads]).  ``scale_hint`` (same shape as the returned scale) floors
+    the scale: pages re-quantized on append keep a monotone scale so
+    already-stored tokens never lose range."""
+    amax = jnp.max(jnp.abs(values.astype(jnp.float32)), axis=(-3, -1))
+    scale = amax / 127.0
+    if scale_hint is not None:
+        scale = jnp.maximum(scale, scale_hint)
+    safe = jnp.maximum(scale, 1e-12)
+    q = jnp.clip(
+        jnp.round(values.astype(jnp.float32)
+                  / safe[..., None, :, None]),
+        -127, 127,
+    ).astype(jnp.int8)
+    return q, jnp.where(scale > 0, scale, 1.0).astype(jnp.float32)
+
+
+def _paged_update_kv_int8(
+    k_slab: QuantizedPages, v_slab: QuantizedPages,
+    k_new, v_new, page_table, index, valid_len,
+):
+    """int8 twin of the fp scatter: quantize AT WRITE TIME.
+
+    Writes land page-at-a-time: for each page a row's new tokens touch,
+    the old page is gathered, dequantized, merged with the new
+    positions, garbage (``>= valid_len``) zeroed, and re-quantized with
+    a per-page-per-head amax scale FLOORED at the page's previous scale
+    (``quantize_pages`` hint) — so a page's scale is monotone over its
+    tenancy and an append can only widen, never clip, what earlier
+    tokens stored.  A page whose first live position is this write
+    (``page_start >= index``) takes a fresh scale: whatever the
+    previous tenant left in the scale slab is garbage, exactly like the
+    value slab's no-zeroing story.
+
+    Shared pages are never written (the pool's COW contract), so the
+    per-row page updates are disjoint and scatter order cannot matter —
+    the same argument as the fp path, at page granularity.
+    """
+    num_pages, page_size = k_slab.values.shape[0], k_slab.values.shape[1]
+    R, Lq = k_new.shape[0], k_new.shape[1]
+    max_pages = page_table.shape[1]
+    index = jnp.reshape(index, (-1,))
+    valid = jnp.reshape(valid_len, (-1,))
+    # pages a row's span [index, index+Lq) can straddle (static bound)
+    n_touch = (Lq - 1) // page_size + 2
+
+    def update_one(slab: QuantizedPages, new) -> QuantizedPages:
+        vals, scales = slab.values, slab.scale
+        new = new.astype(jnp.float32)
+        for j in range(n_touch):
+            lp = index // page_size + j  # [R] logical page
+            in_span = (lp <= (index + Lq - 1) // page_size) & (
+                lp * page_size < valid
+            ) & (lp < max_pages)
+            phys = jnp.take_along_axis(
+                page_table, jnp.clip(lp, 0, max_pages - 1)[:, None],
+                axis=1,
+            )[:, 0]
+            real = in_span & (phys >= 0) & (phys < num_pages)
+            src = jnp.clip(phys, 0, num_pages - 1)
+            old_q = vals[src]                 # [R, ps, H, D]
+            old_s = scales[src]               # [R, H]
+            old_f = old_q.astype(jnp.float32) * old_s[:, None, :, None]
+            gpos = lp[:, None] * page_size + jnp.arange(
+                page_size, dtype=jnp.int32
+            )  # [R, ps] global positions of this page
+            offset = gpos - index[:, None]
+            write_here = (
+                (offset >= 0) & (offset < Lq)
+                & (gpos < valid[:, None])
+            )
+            picked = jnp.take_along_axis(
+                new,
+                jnp.broadcast_to(
+                    jnp.clip(offset, 0, Lq - 1)[:, :, None, None],
+                    (R, page_size) + new.shape[2:],
+                ),
+                axis=1,
+            )
+            merged = jnp.where(write_here[..., None, None], picked,
+                               old_f)
+            live = gpos < valid[:, None]
+            merged = jnp.where(live[..., None, None], merged, 0.0)
+            # a page whose live data starts at this write takes a fresh
+            # scale (the previous tenant's slab entry is stale garbage)
+            has_old = (lp * page_size < index)[:, None]
+            hint = jnp.where(has_old, old_s, 0.0)
+            q, s = quantize_pages(merged, scale_hint=hint)
+            dest = jnp.where(real, phys, num_pages)
+            vals = vals.at[dest].set(q, mode="drop")
+            scales = scales.at[dest].set(s, mode="drop")
+        return QuantizedPages(vals, scales)
+
+    return update_one(k_slab, k_new), update_one(v_slab, v_new)
+
+
 def paged_update_kv(
     k_slab, v_slab, k_new, v_new, page_table, index, valid_len
 ):
@@ -126,7 +241,16 @@ def paged_update_kv(
     partial shared page is copied-on-write into a private page before
     the owner's first append — so scatter destinations are disjoint
     across rows by construction and scatter order cannot matter.
+
+    ``k_slab``/``v_slab`` may be :class:`QuantizedPages` (the
+    ``kv_dtype="int8"`` pool): writes then quantize at write time with
+    per-page-per-head scales kept in the parallel scale slab — see
+    :func:`_paged_update_kv_int8`.
     """
+    if isinstance(k_slab, QuantizedPages):
+        return _paged_update_kv_int8(
+            k_slab, v_slab, k_new, v_new, page_table, index, valid_len
+        )
     num_pages, page_size = k_slab.shape[0], k_slab.shape[1]
     R, Lq = k_new.shape[0], k_new.shape[1]
     max_pages = page_table.shape[1]
@@ -165,8 +289,16 @@ def gather_kv_pages(k_slab, v_slab, page_table):
     current length by the pool's covering invariant, so
     :func:`decode_visibility` masks them exactly like the slot layout
     masks a freed row's stale tail.
+
+    :class:`QuantizedPages` slabs dequantize during the gather (int8
+    value x its page's per-head scale), returning float32 views — the
+    XLA reference path's dequant site; the fused kernel
+    (``ops/paged_attention.py``) dequantizes per block in VMEM instead
+    and never materializes these views at all.
     """
-    num_pages, page_size = k_slab.shape[0], k_slab.shape[1]
+    quantized = isinstance(k_slab, QuantizedPages)
+    vals = k_slab.values if quantized else k_slab
+    num_pages, page_size = vals.shape[0], vals.shape[1]
     R = page_table.shape[0]
     pos = (
         page_table[:, :, None] * page_size
@@ -175,6 +307,15 @@ def gather_kv_pages(k_slab, v_slab, page_table):
     pos = jnp.clip(pos.reshape(R, -1), 0, num_pages * page_size - 1)
 
     def gather(slab):
+        if isinstance(slab, QuantizedPages):
+            flat = slab.values.reshape(
+                (num_pages * page_size,) + slab.values.shape[2:]
+            )
+            page_of = pos // page_size
+            return (
+                flat[pos].astype(jnp.float32)
+                * slab.scale[page_of][:, :, :, None]
+            )
         flat = slab.reshape((num_pages * page_size,) + slab.shape[2:])
         return flat[pos]
 
@@ -311,16 +452,38 @@ def init_paged_caches(
     num_pages: int,
     page_size: int,
     device=None,
+    kv_dtype: Optional[str] = None,
 ) -> List[Tuple[jax.Array, jax.Array]]:
     """Zeroed paged (k, v) slab pairs ``[num_pages, page_size, heads,
     head_dim]``, one per attention layer.  Same total bytes as a slot
     slab whenever ``num_pages * page_size == slots * max_len`` — the
-    equal-memory pivot the paged-vs-slot bench holds fixed."""
+    equal-memory pivot the paged-vs-slot bench holds fixed.
+
+    ``kv_dtype="int8"`` allocates :class:`QuantizedPages` pairs instead:
+    int8 value slabs plus float32 ``[num_pages, heads]`` scale slabs
+    (zero scale dequantizes to zero, so no zeroing pass is ever owed) —
+    ~4x the pages per MB of a float32 pool, ~2x a bf16 one.
+    """
     caches = []
     for spec in specs:
         shape = (num_pages, page_size, spec.num_heads, spec.head_dim)
-        dtype = jnp.dtype(spec.dtype)
-        pair = (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+        if kv_dtype == "int8":
+            def one():
+                return QuantizedPages(
+                    jnp.zeros(shape, jnp.int8),
+                    jnp.zeros((num_pages, spec.num_heads),
+                              jnp.float32),
+                )
+
+            pair = (one(), one())
+        elif kv_dtype is not None:
+            raise ValueError(
+                f"kv_dtype must be 'int8' or None (the model dtype), "
+                f"got {kv_dtype!r}"
+            )
+        else:
+            dtype = jnp.dtype(spec.dtype)
+            pair = (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
         if device is not None:
             pair = jax.device_put(pair, device)
         caches.append(pair)
@@ -332,13 +495,35 @@ def paged_kv_mb_per_layer(
     num_pages: int,
     page_size: int,
     attn_layer_type: str = "GptBlock_Attn",
+    kv_dtype: Optional[str] = None,
 ) -> List[float]:
     """Per-layer paged-pool MB for a layer-config list — the paged twin
-    of :func:`kv_mb_per_layer` (the pool is ``num_pages x page_size``
-    positions instead of ``slots x max_len``, byte-identical formula)."""
-    return kv_mb_per_layer(
-        model_cfg, num_pages, page_size, attn_layer_type=attn_layer_type
-    )
+    of :func:`kv_mb_per_layer`.  ``kv_dtype=None`` keeps the model
+    dtype through the permissive ``jnp.dtype`` itemsize (byte-identical
+    to the slot formula at equal positions — any jnp-valid model dtype
+    stays accountable, exactly as before quantization existed); an
+    EXPLICIT ``kv_dtype`` charges through
+    ``serving/paging.paged_pool_mb`` — the ONE quantized-width formula
+    the allocator, the profiler, and the pre-flight verifier all share
+    (so they can never disagree on pool size), strict about its dtype
+    table because a silently mis-sized quantized pool is the drift the
+    sharing exists to prevent."""
+    from .paging import paged_pool_mb
+
+    out: List[float] = []
+    for cfg in model_cfg:
+        if cfg.get("layer_type") == attn_layer_type:
+            spec = kv_spec_from_config(cfg.get("config", {}), page_size)
+            if kv_dtype is None:
+                out.append(spec.slab_mb(num_pages))
+            else:
+                out.append(paged_pool_mb(
+                    num_pages, page_size, spec.num_heads,
+                    spec.head_dim, kv_dtype=kv_dtype,
+                ))
+        else:
+            out.append(0.0)
+    return out
 
 
 def kv_mb_per_layer(
@@ -366,6 +551,7 @@ def kv_mb_per_layer(
 
 __all__ = [
     "KVCacheSpec",
+    "QuantizedPages",
     "SlotKVCachePool",
     "decode_positions",
     "decode_visibility",
@@ -376,5 +562,6 @@ __all__ = [
     "kv_spec_from_config",
     "paged_kv_mb_per_layer",
     "paged_update_kv",
+    "quantize_pages",
     "update_kv_cache",
 ]

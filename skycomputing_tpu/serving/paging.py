@@ -54,6 +54,70 @@ def pages_for(length: int, page_size: int) -> int:
     return -(-int(length) // int(page_size))
 
 
+#: bytes per stored KV element, by pool dtype — pure stdlib on purpose
+#: (no jnp.dtype here): this table is the ONE place the quantized byte
+#: width is defined, shared by the allocator accounting below, the
+#: engine's slab allocation (serving/kv_cache.py calls back into
+#: :func:`paged_pool_mb`), and the pre-flight verifier
+#: (analysis/plan_check.py) — so "the allocator accepted it" and "the
+#: verifier accepted it" can never disagree on pool size.
+KV_DTYPE_ITEMSIZE: Dict[str, int] = {
+    "int8": 1,
+    "float16": 2,
+    "bfloat16": 2,
+    "float32": 4,
+    "float64": 8,
+}
+
+#: the scale slab's element width (float32 per (page, head) — one scale
+#: per quantized tile, see serving/kv_cache.QuantizedPages)
+KV_SCALE_ITEMSIZE = 4
+
+
+def paged_pool_mb(
+    num_pages: int,
+    page_size: int,
+    num_heads: int,
+    head_dim: int,
+    kv_dtype: str = "float32",
+) -> float:
+    """MB of one attention layer's paged (k, v) pool PAIR.
+
+    ``kv_dtype="int8"`` charges 1-byte values plus the parallel
+    per-page-per-head float32 scale slabs (k and v each carry one) —
+    the scale overhead is ``4 / (page_size * head_dim)`` bytes per
+    position per head, so int8 still lands ~4x the pages per MB of a
+    float32 pool and ~2x a bf16 one (the ``pages_per_mb`` doubling the
+    bench gates).  Unknown dtypes raise: silent fallback here would let
+    the allocator and verifier drift apart.
+    """
+    try:
+        itemsize = KV_DTYPE_ITEMSIZE[str(kv_dtype)]
+    except KeyError:
+        raise ValueError(
+            f"unknown kv_dtype {kv_dtype!r}; known: "
+            f"{sorted(KV_DTYPE_ITEMSIZE)}"
+        ) from None
+    n = float(num_pages) * page_size * num_heads * head_dim
+    values = 2.0 * n * itemsize  # the (k, v) pair
+    scales = (
+        2.0 * float(num_pages) * num_heads * KV_SCALE_ITEMSIZE
+        if str(kv_dtype) == "int8" else 0.0
+    )
+    return (values + scales) / 1024.0 ** 2
+
+
+def pages_per_mb(
+    page_size: int, num_heads: int, head_dim: int,
+    kv_dtype: str = "float32",
+) -> float:
+    """Pages one MB of pool holds at this dtype — the capacity knob the
+    int8 policy turns (scale-slab overhead included)."""
+    per_page = paged_pool_mb(1, page_size, num_heads, head_dim,
+                             kv_dtype=kv_dtype)
+    return 1.0 / per_page
+
+
 # --------------------------------------------------------------------------
 # radix prefix index
 # --------------------------------------------------------------------------
@@ -248,6 +312,7 @@ class PagedKVCachePool:
         *,
         enable_prefix_cache: bool = True,
         max_prefix_entries: int = 256,
+        kv_dtype: str = "float32",
     ):
         if num_pages < 1 or page_size < 1:
             raise ValueError(
@@ -262,6 +327,14 @@ class PagedKVCachePool:
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
         self.max_pages_per_request = int(max_pages_per_request)
+        # the allocator POLICY knob: what a page physically stores.
+        # "int8" pages carry a parallel per-page-per-head scale slab —
+        # the accounting here (pool_mb) and every page copy the pool
+        # plans (cow_plan, the engine's swap path) must include it.
+        # Any other string is carried verbatim as the MODEL dtype (the
+        # engine passes it through for accounting/labels; only
+        # pool_mb's byte table is strict, and only when asked).
+        self.kv_dtype = str(kv_dtype)
         self.enable_prefix_cache = bool(enable_prefix_cache)
         # LIFO free list, same warm-row rationale as the slot pool
         self._free: List[int] = list(range(self.num_pages))[::-1]
@@ -300,6 +373,31 @@ class PagedKVCachePool:
     def virtual_len(self) -> int:
         """Positions one request can span: the paged ``max_len``."""
         return self.max_pages_per_request * self.page_size
+
+    def pool_mb(self, num_heads: int, head_dim: int) -> float:
+        """One attention layer's (k, v) pool MB at this pool's
+        ``kv_dtype`` — scale slabs included under int8 (the single
+        quantized-width formula, see :func:`paged_pool_mb`)."""
+        return paged_pool_mb(
+            self.num_pages, self.page_size, num_heads, head_dim,
+            kv_dtype=self.kv_dtype,
+        )
+
+    def cow_plan(self, grant: "PageGrant") -> List[Tuple[str, int, int]]:
+        """Device copies a grant's copy-on-write clone requires:
+        ``[("values", src, dst)]`` — plus ``("scales", src, dst)`` on an
+        int8 pool, because a cloned page dequantized with the DONOR's
+        scale but re-scaled under its new owner would silently corrupt
+        the shared prefix.  The engine executes this plan across every
+        stage's slabs; an empty list means no COW was granted."""
+        if grant.cow_src is None:
+            return []
+        plan: List[Tuple[str, int, int]] = [
+            ("values", grant.cow_src, grant.cow_dst)
+        ]
+        if self.kv_dtype == "int8":
+            plan.append(("scales", grant.cow_src, grant.cow_dst))
+        return plan
 
     def table(self, request_id: int) -> List[int]:
         return list(self._tables[request_id])
@@ -727,11 +825,14 @@ def choose_preempt_mode(
 
 __all__ = [
     "ChunkBudgetPolicy",
+    "KV_DTYPE_ITEMSIZE",
     "PageGrant",
     "PagedKVCachePool",
     "RadixPrefixIndex",
     "RowAllocator",
     "choose_preempt_mode",
+    "paged_pool_mb",
     "pages_for",
+    "pages_per_mb",
     "preempt_costs",
 ]

@@ -58,6 +58,7 @@ class DecodeModelBenchmarker(BaseBenchmarker):
         attn_layer_type: str = "GptBlock_Attn",
         num_pages: Optional[int] = None,
         page_size: Optional[int] = None,
+        kv_dtype: Optional[str] = None,
     ):
         if slots < 1 or max_len < 1:
             raise ValueError(
@@ -73,6 +74,11 @@ class DecodeModelBenchmarker(BaseBenchmarker):
                 f"need positive num_pages/page_size, got "
                 f"{num_pages}/{page_size}"
             )
+        if kv_dtype is not None and num_pages is None:
+            raise ValueError(
+                "kv_dtype is a paged-pool policy; pass num_pages/"
+                "page_size with it"
+            )
         self._model_config = model_config
         # paged engines: `slots` is the decode-row count
         # (max_concurrency) and `max_len` the per-request virtual span
@@ -83,6 +89,7 @@ class DecodeModelBenchmarker(BaseBenchmarker):
         self._max_len = int(max_len)
         self._num_pages = None if num_pages is None else int(num_pages)
         self._page_size = None if page_size is None else int(page_size)
+        self._kv_dtype = None if kv_dtype is None else str(kv_dtype)
         self._param_scale = int(param_scale)
         self._attn_layer_type = attn_layer_type
         self._result: Optional[Tuple[List[float], List[float]]] = None
@@ -101,6 +108,8 @@ class DecodeModelBenchmarker(BaseBenchmarker):
         if self._num_pages is not None:
             point.update(num_pages=self._num_pages,
                          page_size=self._page_size)
+            if self._kv_dtype is not None:
+                point.update(kv_dtype=self._kv_dtype)
         return point
 
     def benchmark(self) -> Tuple[List[float], List[float]]:
@@ -121,6 +130,7 @@ class DecodeModelBenchmarker(BaseBenchmarker):
             kv_mb = paged_kv_mb_per_layer(
                 self._model_config, self._num_pages, self._page_size,
                 attn_layer_type=self._attn_layer_type,
+                kv_dtype=self._kv_dtype,
             )
         else:
             kv_mb = kv_mb_per_layer(

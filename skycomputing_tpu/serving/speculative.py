@@ -104,6 +104,7 @@ class DraftModel:
         *,
         extra_param_mb: float = 0.0,
         program_key: Optional[str] = None,
+        attn_impl: str = "xla",
     ):
         self.modules = list(modules)
         self.params = list(params)
@@ -115,6 +116,7 @@ class DraftModel:
                 "draft with"
             )
         self.extra_param_mb = float(extra_param_mb)
+        self.attn_impl = attn_impl
         cached = (
             _DRAFT_PROGRAMS.get(program_key)
             if program_key is not None else None
@@ -123,6 +125,7 @@ class DraftModel:
             self._step_donated, self._loop_donated = cached
             return
         mods = self.modules
+        impl = attn_impl
 
         def step(params_list, tokens, slabs, tables, index, valid_len):
             # argmax FUSED into the program: drafting is greedy by
@@ -132,7 +135,7 @@ class DraftModel:
             # ids out, no per-step device->host sync
             out, new_slabs = apply_kv_paged(
                 mods, params_list, tokens[:, None], slabs, tables,
-                index, valid_len,
+                index, valid_len, attn_impl=impl,
             )
             nxt = jnp.argmax(out[:, 0], axis=-1).astype(jnp.int32)
             return nxt, new_slabs
@@ -170,13 +173,15 @@ class DraftModel:
 
     @staticmethod
     def program_key(
-        draft_cfgs: Sequence[Dict], max_len: int
+        draft_cfgs: Sequence[Dict], max_len: int,
+        attn_impl: str = "xla", kv_dtype=None,
     ) -> str:
         """Cache key: the sliced layer configs + cache depth + donation
-        (the engine's stage program-key recipe, draft flavored)."""
+        + the attention impl / KV storage dtype (both change traced
+        code) — the engine's stage program-key recipe, draft flavored."""
         return json.dumps(
             ["draft", list(draft_cfgs), int(max_len),
-             bool(_donation_enabled())],
+             bool(_donation_enabled()), str(attn_impl), str(kv_dtype)],
             sort_keys=True, default=str,
         )
 

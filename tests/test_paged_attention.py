@@ -1,0 +1,216 @@
+"""Fused paged-attention kernel contracts (CPU-deterministic, tier-1).
+
+The kernel (``ops/paged_attention.py``) walks the page table inside a
+Pallas program; off-TPU it runs in interpret mode, which is how this
+suite pins it — bit-level agreement with the XLA reference on the
+contract's edge cases (page-boundary crossings, sentinel-padded tables,
+1-row and full-wave shapes, decode and speculative-verify query
+lengths), and bounded error for the int8-quantized page variant whose
+dequant happens in-kernel.  The engine-level routing (``attn_impl=``,
+``kv_dtype=``, the bounded live gather) is pinned in
+``tests/test_serving.py``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skycomputing_tpu.ops.paged_attention import (
+    paged_attention,
+    paged_attention_reference,
+)
+from skycomputing_tpu.serving.kv_cache import (
+    QuantizedPages,
+    gather_kv_pages,
+    init_paged_caches,
+    paged_update_kv,
+    quantize_pages,
+)
+from skycomputing_tpu.serving import KVCacheSpec
+
+pytestmark = pytest.mark.serving
+
+P, PS, H, D = 10, 4, 2, 16
+
+
+def _case(rng, R, Lq, tables, index, quantized=False):
+    q = rng.standard_normal((R, Lq, H, D)).astype(np.float32)
+    if quantized:
+        k = rng.integers(-127, 128, (P, PS, H, D)).astype(np.int8)
+        v = rng.integers(-127, 128, (P, PS, H, D)).astype(np.int8)
+        ks = rng.uniform(0.005, 0.03, (P, H)).astype(np.float32)
+        vs = rng.uniform(0.005, 0.03, (P, H)).astype(np.float32)
+        out = paged_attention(q, k, v, tables, index, k_scale=ks,
+                              v_scale=vs, interpret=True)
+        ref = paged_attention_reference(q, k, v, tables, index,
+                                        k_scale=ks, v_scale=vs)
+    else:
+        k = rng.standard_normal((P, PS, H, D)).astype(np.float32)
+        v = rng.standard_normal((P, PS, H, D)).astype(np.float32)
+        out = paged_attention(q, k, v, tables, index, interpret=True)
+        ref = paged_attention_reference(q, k, v, tables, index)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_kernel_matches_reference_across_page_boundary():
+    """A sequence whose causal bound sits mid-table (crossing page
+    boundaries) produces the reference output exactly — the online
+    softmax accumulates the same masked blocks the gather would."""
+    rng = np.random.default_rng(0)
+    t = np.full((1, 3), P, np.int32)
+    t[0, :3] = [7, 2, 5]
+    _case(rng, 1, 1, t, np.array([8], np.int32))  # len 9 over ps=4
+
+
+def test_kernel_masks_sentinel_and_clamped_entries():
+    """Sentinel table entries (>= num_pages) clamp to a real page whose
+    positions are past the causal bound — masked garbage, never a NaN
+    (the fully-masked-block skip) and never a wrong value."""
+    rng = np.random.default_rng(1)
+    t = np.full((3, 5), P, np.int32)
+    t[0, :3] = [7, 2, 5]
+    t[1, :2] = [0, 9]
+    t[2, :5] = [1, 3, 4, 6, 8]
+    _case(rng, 3, 1, t, np.array([8, 4, 16], np.int32))
+    out_sentinel_heavy = np.full((2, 4), P, np.int32)
+    out_sentinel_heavy[0, 0] = 3
+    out_sentinel_heavy[1, 0] = 1
+    _case(rng, 2, 1, out_sentinel_heavy, np.array([0, 2], np.int32))
+
+
+def test_kernel_verify_shape_and_full_wave():
+    """The speculative-verify query length (Lq = k + 1) and a full wave
+    of rows agree with the reference — one program shape per (rows,
+    Lq, width), the engine's compile discipline."""
+    rng = np.random.default_rng(2)
+    t = np.full((3, 5), P, np.int32)
+    t[0, :3] = [7, 2, 5]
+    t[1, :2] = [0, 9]
+    t[2, :5] = [1, 3, 4, 6, 8]
+    _case(rng, 3, 4, t, np.array([5, 0, 12], np.int32))
+
+
+def test_kernel_int8_dequant_matches_reference():
+    """The in-kernel dequant (int8 block x per-page-per-head scale)
+    equals the materializing dequantized gather."""
+    rng = np.random.default_rng(3)
+    t = np.full((3, 5), P, np.int32)
+    t[0, :3] = [7, 2, 5]
+    t[1, :2] = [0, 9]
+    t[2, :5] = [1, 3, 4, 6, 8]
+    _case(rng, 3, 1, t, np.array([8, 4, 16], np.int32),
+          quantized=True)
+
+
+# --------------------------------------------------------------------------
+# int8 write-time quantization (the scale slab's contract)
+# --------------------------------------------------------------------------
+
+
+def test_int8_update_bounded_error_and_midpage_valid():
+    """Quantize-on-write round-trips within int8 error bounds, a
+    mid-page ``valid_len`` zeroes the garbage tail (it must not poison
+    the page's amax scale), and positions past ``valid_len`` never
+    influence stored values."""
+    spec = KVCacheSpec(max_len=32, num_heads=H, head_dim=D,
+                       dtype="float32")
+    (kq, vq), = init_paged_caches([spec], P, PS, kv_dtype="int8")
+    (kf, vf), = init_paged_caches([spec], P, PS)
+    rng = np.random.default_rng(4)
+    table = np.full((2, 4), P, np.int32)
+    table[0, :3] = [3, 1, 5]
+    table[1, :2] = [0, 2]
+    R, Lq = 2, 9
+    knew = rng.standard_normal((R, Lq, H, D)).astype(np.float32)
+    vnew = rng.standard_normal((R, Lq, H, D)).astype(np.float32)
+    # row 1 ends MID-PAGE: valid 5 of a 9-token write — the pad tail
+    # (offsets 5..8) must drop, and page garbage past 5 must read 0
+    index = np.array([0, 0], np.int32)
+    valid = np.array([9, 5], np.int32)
+    args = (jnp.asarray(table), jnp.asarray(index), jnp.asarray(valid))
+    kq2, vq2 = paged_update_kv(kq, vq, jnp.asarray(knew),
+                               jnp.asarray(vnew), *args)
+    kf2, vf2 = paged_update_kv(kf, vf, jnp.asarray(knew),
+                               jnp.asarray(vnew), *args)
+    gq, _ = gather_kv_pages(kq2, vq2, jnp.asarray(table))
+    gf, _ = gather_kv_pages(kf2, vf2, jnp.asarray(table))
+    for r in range(R):
+        n = int(valid[r])
+        ref = np.asarray(gf)[r, :n]
+        err = np.max(np.abs(np.asarray(gq)[r, :n] - ref))
+        assert err / np.max(np.abs(ref)) < 0.02, (
+            "int8 write round-trip exceeded the error bound"
+        )
+    # the mid-page garbage tail of row 1's second page reads exactly 0
+    # (zeroed at quantization so stale values can't poison the scale)
+    tail = np.asarray(gq)[1, 5:8]
+    np.testing.assert_array_equal(tail, np.zeros_like(tail))
+
+
+def test_int8_append_keeps_scale_monotone():
+    """A decode append re-quantizes its tail page with a scale floored
+    at the page's previous scale — earlier tokens never lose range, so
+    repeated appends stay within the same bounded error."""
+    spec = KVCacheSpec(max_len=32, num_heads=H, head_dim=D,
+                       dtype="float32")
+    (kq, vq), = init_paged_caches([spec], P, PS, kv_dtype="int8")
+    rng = np.random.default_rng(5)
+    table = np.full((1, 2), P, np.int32)
+    table[0, :2] = [4, 6]
+    # big first token, then small appends: amax would SHRINK without
+    # the monotone floor and re-quantize the first token coarsely
+    big = 8.0 * rng.standard_normal((1, 1, H, D)).astype(np.float32)
+    kq, vq = paged_update_kv(
+        kq, vq, jnp.asarray(big), jnp.asarray(big),
+        jnp.asarray(table), jnp.asarray([0]), jnp.asarray([1]),
+    )
+    scale_after_big = np.asarray(kq.scale[4]).copy()
+    small = 0.01 * rng.standard_normal((1, 1, H, D)).astype(np.float32)
+    for step in range(1, 4):
+        kq, vq = paged_update_kv(
+            kq, vq, jnp.asarray(small), jnp.asarray(small),
+            jnp.asarray(table), jnp.asarray([step]),
+            jnp.asarray([step + 1]),
+        )
+    assert np.all(np.asarray(kq.scale[4]) >= scale_after_big - 1e-9)
+    gk, _ = gather_kv_pages(kq, vq, jnp.asarray(table))
+    rel = np.max(np.abs(np.asarray(gk)[0, 0] - big[0, 0])) / np.max(
+        np.abs(big)
+    )
+    assert rel < 0.02
+
+
+def test_quantize_pages_fresh_page_ignores_stale_scale():
+    """quantize_pages with a zero hint (a fresh page) picks the amax
+    scale; with a larger hint it floors to the hint — the two rules
+    behind stale-slab safety and append monotonicity."""
+    rng = np.random.default_rng(6)
+    page = rng.standard_normal((1, PS, H, D)).astype(np.float32)
+    q, s = quantize_pages(jnp.asarray(page))
+    amax = np.abs(page).max(axis=(1, 3))
+    np.testing.assert_allclose(np.asarray(s), amax / 127.0, rtol=1e-6)
+    q2, s2 = quantize_pages(
+        jnp.asarray(page), scale_hint=jnp.full((1, H), 1e3)
+    )
+    np.testing.assert_allclose(np.asarray(s2), 1e3)
+    # an all-zero page quantizes to zeros with the safe unit scale
+    qz, sz = quantize_pages(jnp.zeros((1, PS, H, D)))
+    assert np.all(np.asarray(qz) == 0) and np.all(np.asarray(sz) == 1.0)
+
+
+def test_quantized_pages_ride_jit_and_pytrees():
+    """QuantizedPages is a pytree: it crosses jit boundaries (the
+    engine's donated stage programs) with type and dtypes intact."""
+    qp = QuantizedPages(jnp.zeros((P, PS, H, D), jnp.int8),
+                        jnp.ones((P, H), jnp.float32))
+
+    @jax.jit
+    def bump(s):
+        return QuantizedPages(s.values, s.scale * 2.0)
+
+    out = bump(qp)
+    assert isinstance(out, QuantizedPages)
+    assert out.values.dtype == jnp.int8
+    assert float(out.scale[0, 0]) == 2.0

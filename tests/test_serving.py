@@ -664,6 +664,199 @@ def test_paged_reconfigure_verify_then_apply(gpt):
 
 
 # --------------------------------------------------------------------------
+# fused kernel + int8 KV pages (PR 12)
+# --------------------------------------------------------------------------
+
+
+def test_paged_gather_bound_live_vs_full_identity(gpt):
+    """The bounded live-width gather (gather_pages="live", the default)
+    is pure shape bookkeeping: outputs are token-identical to the
+    full-table-width baseline AND to one-shot generate — positions a
+    narrower gather drops were exactly the ones the causal mask already
+    zeroed."""
+    layer_cfgs, params, fwd = gpt
+    specs = [(5, 8), (3, 4), (14, 6), (9, 3)]
+    rng = np.random.default_rng(31)
+    live_reqs = mixed_requests(rng, specs)
+    full_reqs = [
+        Request(prompt=r.prompt.copy(), max_new_tokens=r.max_new_tokens)
+        for r in live_reqs
+    ]
+    live = paged_engine(layer_cfgs, params)
+    assert live.gather_pages == "live"
+    full = paged_engine(layer_cfgs, params, gather_pages="full")
+    l_out = live.run(live_reqs)
+    f_out = full.run(full_reqs)
+    for lr, fr in zip(live_reqs, full_reqs):
+        np.testing.assert_array_equal(
+            l_out[lr.request_id], reference(fwd, lr)
+        )
+        np.testing.assert_array_equal(
+            l_out[lr.request_id], f_out[fr.request_id]
+        )
+
+
+def test_paged_attn_impl_pallas_identity_and_recompile_pin(gpt):
+    """attn_impl="pallas" (interpret mode on CPU): greedy streams are
+    token-identical to the XLA reference engine and to generate, and
+    after bucket + span-width warmup the steady state pins ZERO XLA
+    compiles — the recompile discipline extended to the kernel path."""
+    layer_cfgs, params, fwd = gpt
+    kw = dict(num_slots=2, max_len=32, buckets=(8,), prefill_batch=1,
+              kv_layout="paged", page_size=8, max_pages_per_request=4,
+              num_pages=12, max_concurrency=2)
+    pallas = ServingEngine(layer_cfgs, params, attn_impl="pallas", **kw)
+    assert pallas.attn_impl == "pallas"
+    xla = ServingEngine(layer_cfgs, params, attn_impl="xla", **kw)
+    for e in (pallas, xla):
+        # bucket warm + span warm: a short prompt decoding across the
+        # span sweeps every live-gather width through compilation
+        e.run([Request(prompt=np.full((8,), 9, np.int32),
+                       max_new_tokens=2)])
+        e.run([Request(prompt=np.full((2,), 3, np.int32),
+                       max_new_tokens=20)])
+    warm = xla_compile_count()
+    rng = np.random.default_rng(32)
+    specs = [(5, 4), (3, 3)]
+    p_reqs = mixed_requests(rng, specs)
+    x_reqs = [
+        Request(prompt=r.prompt.copy(), max_new_tokens=r.max_new_tokens)
+        for r in p_reqs
+    ]
+    p_out = pallas.run(p_reqs)
+    assert xla_compile_count() == warm, (
+        "steady-state pallas serving recompiled after warmup"
+    )
+    x_out = xla.run(x_reqs)
+    for pr, xr in zip(p_reqs, x_reqs):
+        np.testing.assert_array_equal(
+            p_out[pr.request_id], x_out[xr.request_id]
+        )
+        np.testing.assert_array_equal(
+            p_out[pr.request_id], reference(fwd, pr)
+        )
+
+
+def test_paged_int8_agreement_and_observability(gpt):
+    """kv_dtype="int8": bounded-error pages keep greedy streams in high
+    positional agreement with the fp engine (exactness is NOT the
+    contract — near-tie argmax flips compound), the quant counters
+    move, /healthz names the active kv_dtype/attn_impl, the prefix-
+    cache/COW path stays refcount-consistent, and generation lengths
+    are untouched."""
+    layer_cfgs, params, fwd = gpt
+    rng = np.random.default_rng(33)
+    specs = [(5, 9), (3, 4), (12, 7), (7, 5), (14, 6), (2, 8)]
+    fp_reqs = mixed_requests(rng, specs)
+    i8_reqs = [
+        Request(prompt=r.prompt.copy(), max_new_tokens=r.max_new_tokens)
+        for r in fp_reqs
+    ]
+    fp = paged_engine(layer_cfgs, params, prefill_batch=2)
+    i8 = paged_engine(layer_cfgs, params, prefill_batch=2,
+                      kv_dtype="int8")
+    fp_out = fp.run(fp_reqs)
+    i8_out = i8.run(i8_reqs)
+    agree = total = 0
+    for fr, ir in zip(fp_reqs, i8_reqs):
+        x = fp_out[fr.request_id][len(fr.prompt):]
+        y = i8_out[ir.request_id][len(ir.prompt):]
+        assert x.size == y.size  # budgets untouched by quantization
+        agree += int((x == y).sum())
+        total += int(x.size)
+    assert agree / total >= 0.5, (
+        f"int8 greedy agreement {agree}/{total} below the gate"
+    )
+    stats = i8.stats
+    assert stats.quantized_pages > 0 and stats.dequant_blocks > 0
+    assert fp.stats.quantized_pages == 0  # fp engines never quantize
+    snap = i8._health_snapshot()
+    assert snap["kv_dtype"] == "int8" and snap["attn_impl"] == "xla"
+    assert fp._health_snapshot()["kv_dtype"] == "float32"
+    # shared-prefix COW on the quantized pool: the scale row clones
+    # with the values (pool.cow_plan names both), refcounts audited
+    system = rng.integers(1, 512, (12,)).astype(np.int32)
+    for _ in range(2):
+        i8.run([Request(prompt=np.concatenate(
+            [system, rng.integers(1, 512, (3,)).astype(np.int32)]),
+            max_new_tokens=3)])
+    assert i8.stats.prefix_hits >= 1 and i8.stats.cow_copies >= 1
+    i8._pool.check_consistency()
+    assert i8._pool.kv_dtype == "int8"
+
+
+def test_paged_kv_dtype_charging_and_validation(gpt):
+    """The pre-flight charges int8 pools at the quantized byte width
+    (values + scale slabs, the allocator's own formula) — ~4x below a
+    float32 pool — and malformed/misplaced kv_dtype knobs are rejected
+    with named diagnostics, never silently mis-accounted."""
+    from skycomputing_tpu.analysis.plan_check import (
+        _serving_kv_profile,
+    )
+    from skycomputing_tpu.serving import (
+        DecodeModelBenchmarker,
+        paged_kv_mb_per_layer,
+        paged_pool_mb,
+    )
+
+    layer_cfgs, params, _ = gpt
+    fp = paged_kv_mb_per_layer(layer_cfgs, 12, 8)
+    i8 = paged_kv_mb_per_layer(layer_cfgs, 12, 8, kv_dtype="int8")
+    ratio = sum(fp) / sum(i8)
+    assert ratio > 3.5  # fp32 model: 4x minus the scale-slab overhead
+    # the engine's own context carries kv_dtype (verifier parity)
+    engine = paged_engine(layer_cfgs, params, kv_dtype="int8")
+    ctx = engine._serving_context()
+    assert ctx["kv_dtype"] == "int8"
+    issues = []
+    prof = _serving_kv_profile(layer_cfgs, ctx, issues, "error")
+    assert not issues
+    attn = [m for m in prof if m > 0]
+    assert attn and abs(
+        attn[0] - paged_pool_mb(engine.num_pages, engine.page_size,
+                                2, 32, kv_dtype="int8")
+    ) < 1e-9
+    # unknown dtype -> diagnostic; slot context + kv_dtype -> rejected
+    bad = []
+    assert _serving_kv_profile(
+        layer_cfgs, dict(num_pages=12, page_size=8, kv_dtype="int4"),
+        bad, "error",
+    ) is None and "int4" in bad[0].message
+    bad = []
+    assert _serving_kv_profile(
+        layer_cfgs, dict(slots=2, max_len=32, kv_dtype="int8"),
+        bad, "error",
+    ) is None and "paged" in bad[0].message
+    # the engine rejects the knob off the paged layout outright
+    with pytest.raises(ValueError, match="kv_layout='paged'"):
+        ServingEngine(layer_cfgs, params, num_slots=2, max_len=32,
+                      buckets=(8,), kv_dtype="int8")
+    with pytest.raises(ValueError, match="kv_dtype"):
+        paged_engine(layer_cfgs, params, kv_dtype="int4")
+    # the decode profiler stamps + charges the same formula
+    bench = DecodeModelBenchmarker(
+        layer_cfgs, slots=4, max_len=32, num_pages=12, page_size=8,
+        kv_dtype="int8",
+    )
+    assert bench.operating_point["kv_dtype"] == "int8"
+    bench_fp = DecodeModelBenchmarker(
+        layer_cfgs, slots=4, max_len=32, num_pages=12, page_size=8,
+    )
+    _, mem_i8 = bench.benchmark()
+    _, mem_fp = bench_fp.benchmark()
+    attn_idx = [i for i, cfg in enumerate(layer_cfgs)
+                if cfg.get("layer_type") == "GptBlock_Attn"]
+    for i in attn_idx:
+        # same compute profile, pool charged at the quantized width
+        assert mem_fp[i] - mem_i8[i] == pytest.approx(
+            fp[i] - i8[i]
+        )
+    with pytest.raises(ValueError, match="paged-pool policy"):
+        DecodeModelBenchmarker(layer_cfgs, slots=4, max_len=32,
+                               kv_dtype="int8")
+
+
+# --------------------------------------------------------------------------
 # chunked prefill + speculative decoding
 # --------------------------------------------------------------------------
 
@@ -1077,6 +1270,39 @@ def test_bench_serving_chunk_spec_smoke(tmp_path):
     assert spec["gates"]["zero_steady_state_recompiles"]
     assert spec["draft_exact"] is True
     assert spec["accept_rate"] == 1.0
+
+
+@pytest.mark.slow
+def test_bench_serving_kernel_smoke(tmp_path):
+    """`bench_serving --kernel --smoke` completes with the mechanics
+    gates green (live-gather and pallas token identity, zero
+    steady-state recompiles on every impl, pages/MB gain, int8
+    agreement, quant counters) and stamps the kernel/quant schema the
+    full-run timing gates read."""
+    out = tmp_path / "BENCH_kernel.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.bench_serving", "--kernel",
+         "--smoke", "--out", str(out)],
+        capture_output=True, text=True, timeout=560,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    import json
+
+    report = json.loads(out.read_text())
+    kq = report["kernel_quant"]
+    gates = kq["gates"]
+    assert gates["live_token_identical"]
+    assert gates["live_matches_full_gather"]
+    assert gates["pallas_matches_xla"]
+    assert gates["zero_steady_state_recompiles_xla"]
+    assert gates["zero_steady_state_recompiles_pallas"]
+    assert gates["zero_steady_state_recompiles_int8"]
+    assert gates["pages_per_mb_gain_over_1_9x"]
+    assert kq["pages_per_mb_gain"] >= 1.9
+    assert gates["int8_agreement_over_0_7"]
+    assert gates["quant_counters_move"]
+    assert kq["int8"]["kv_dtype"] == "int8"
+    assert kq["pallas_leg"]["pallas"]["attn_impl"] == "pallas"
 
 
 @pytest.mark.slow

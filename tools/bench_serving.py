@@ -38,6 +38,17 @@ Evidence artifact for the serving subsystem, three comparisons:
   the non-speculative engine on the same model, token-identical,
   zero steady-state recompiles.
 
+- **fused kernel + int8 pages** (full run / ``--kernel``): three legs.
+  (1) the bounded ``gather_pages="live"`` decode (page-table width =
+  the wave's live span) vs PR 9's materializing full-width gather at
+  equal workload — per-token decode wall must improve, token-identical,
+  zero recompiles; (2) ``kv_dtype="int8"`` at the same operating point
+  — pages/MB >= 1.9x (scale slabs charged), greedy-stream agreement
+  and first-token fidelity gated, quant counters live; (3) the Pallas
+  kernel in interpret mode on its own tiny instance — token-identical
+  to the XLA engine (a correctness surface: the compiled kernel needs
+  a TPU, so no CPU timing claim is made for it).
+
 Usage::
 
     python -m tools.bench_serving                # full run, all sections
@@ -45,6 +56,7 @@ Usage::
     python -m tools.bench_serving --paged        # paged sections only
     python -m tools.bench_serving --chunked      # chunked-prefill section
     python -m tools.bench_serving --spec         # speculation section
+    python -m tools.bench_serving --kernel       # kernel/int8 section
     python -m tools.bench_serving --out path.json --stages 2
 """
 
@@ -316,11 +328,16 @@ def run_backlog(layer_cfgs, params, specs, pcfg, prefill_chunk):
                   max_chunk_rows=pcfg.get("max_chunk_rows"))
     engine = ServingEngine(layer_cfgs, params, **kw)
     # warmup: one request per bucket — chunk waves reuse the bucket
-    # programs, so this warms the chunked engine too (no new shapes)
+    # programs, so this warms the chunked engine too (no new shapes) —
+    # plus one short-prompt span warm decoding across the virtual span
+    # so every live-gather table width compiles before the window
+    span = pcfg["max_pages_per_request"] * pcfg["page_size"]
     engine.run([
         Request(prompt=np.full((b,), b + 1, np.int32), max_new_tokens=2)
         for b in pcfg["buckets"]
     ])
+    engine.run([Request(prompt=np.full((2,), 401, np.int32),
+                        max_new_tokens=span - 4)])
     requests = [Request(prompt=p, max_new_tokens=n) for p, n in specs]
     compiles0 = engine.stats.compiles
     for r in requests:
@@ -413,12 +430,17 @@ def run_spec_mode(layer_cfgs, params, specs, pcfg, spec_k):
     engine = ServingEngine(layer_cfgs, params, **kw)
     # warmup: bucket programs + (spec) the one-dispatch k-step draft
     # loop and the Lq=spec_k+1 verify program — generations long
-    # enough to hit spec ticks
+    # enough to hit spec ticks — plus one short-prompt span warm that
+    # decodes (or spec-ticks) across the virtual span, compiling every
+    # live-gather table width for draft, verify, and decode alike
+    span = pcfg["max_pages_per_request"] * pcfg["page_size"]
     engine.run([
         Request(prompt=np.full((b,), b + 1, np.int32),
                 max_new_tokens=spec_k + 2 if spec_k else 2)
         for b in pcfg["buckets"]
     ])
+    engine.run([Request(prompt=np.full((2,), 401, np.int32),
+                        max_new_tokens=span - 4)])
     compiles0 = engine.stats.compiles
     generated = sum(n for _, n in specs)
     # median of 3 timed repeats: the 1.5x gate must not ride one
@@ -452,6 +474,74 @@ def run_spec_mode(layer_cfgs, params, specs, pcfg, spec_k):
     }, {r.request_id: outputs[r.request_id] for r in requests}, requests
 
 
+def run_kernel_engine(layer_cfgs, params, specs, kcfg, *,
+                      gather="live", kv_dtype=None, attn_impl=None):
+    """One kernel-section engine run: warm every bucket AND every
+    live-gather width (one span-warm request decoding across the
+    power-of-two page-width set), then drain the workload with decode
+    wall/compiles/counters isolated."""
+    from skycomputing_tpu.serving import Request, ServingEngine
+
+    kw = dict(
+        num_slots=kcfg["slots"], max_len=kcfg["max_len"],
+        buckets=kcfg["buckets"], prefill_batch=kcfg["prefill_batch"],
+        partition=kcfg["partition"], kv_layout="paged",
+        page_size=kcfg["page_size"], num_pages=kcfg["num_pages"],
+        max_pages_per_request=kcfg["max_pages_per_request"],
+        max_concurrency=kcfg["max_concurrency"], gather_pages=gather,
+    )
+    if kv_dtype:
+        kw["kv_dtype"] = kv_dtype
+    if attn_impl:
+        kw["attn_impl"] = attn_impl
+    engine = ServingEngine(layer_cfgs, params, **kw)
+    engine.run([
+        Request(prompt=np.full((b,), b + 1, np.int32), max_new_tokens=2)
+        for b in kcfg["buckets"]
+    ])
+    # span warm: one short-prompt request decoding across the
+    # workload's whole live span, so every live-gather table width —
+    # from the floor up through every power-of-two the workload can
+    # reach — compiles BEFORE the measured window (the live-gather
+    # twin of per-bucket warmup; a 2-token prompt starts the sweep at
+    # the smallest width)
+    engine.run([Request(
+        prompt=np.full((2,), 401, np.int32),
+        max_new_tokens=kcfg["span_warm_new"],
+    )])
+    requests = [Request(prompt=p.copy(), max_new_tokens=n)
+                for p, n in specs]
+    # warmup-excluded deltas for EVERY reported figure (the span warm
+    # quantizes ~span worth of pages itself — cumulative counters
+    # would inflate any per-token rate a reader derives)
+    compiles0 = engine.stats.compiles
+    decode_s0 = engine.stats.decode_s
+    decode_tokens0 = engine.stats.decode_tokens
+    quant0 = engine.stats.quantized_pages
+    dequant0 = engine.stats.dequant_blocks
+    t0 = time.perf_counter()
+    outputs = engine.run(requests)
+    wall_s = time.perf_counter() - t0
+    snap = engine.stats.snapshot()
+    decode_s = snap["decode_s"] - decode_s0
+    decode_tokens = snap["decode_tokens"] - decode_tokens0
+    return {
+        "gather_pages": gather,
+        "kv_dtype": kv_dtype or "float32",
+        "attn_impl": engine.attn_impl,
+        "wall_s": wall_s,
+        "decode_s": decode_s,
+        "decode_tokens": decode_tokens,
+        "decode_s_per_token": (
+            decode_s / decode_tokens if decode_tokens else None
+        ),
+        "steady_state_compiles": snap["compiles"] - compiles0,
+        "quantized_pages": snap["quantized_pages"] - quant0,
+        "dequant_blocks": snap["dequant_blocks"] - dequant0,
+        "stats": snap,
+    }, {r.request_id: outputs[r.request_id] for r in requests}, requests
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--smoke", action="store_true",
@@ -465,6 +555,9 @@ def main() -> int:
     parser.add_argument("--spec", action="store_true",
                         help="run ONLY the speculative-decoding section "
                              "(the full run includes it)")
+    parser.add_argument("--kernel", action="store_true",
+                        help="run ONLY the fused-kernel/int8-quant "
+                             "section (the full run includes it)")
     parser.add_argument("--out", default="BENCH_serving.json")
     parser.add_argument("--stages", type=int, default=1,
                         help="pipeline stages to split the stack over")
@@ -509,6 +602,13 @@ def main() -> int:
                         max_concurrency=3, n_requests=4,
                         lo_new=6, hi_new=10,
                         spec_k=2, draft_blocks=1, vocab_size=512)
+        # kernel/quant A/B: table width 10 pages, live spans <= 3 pages
+        kernel_cfg = dict(slots=3, max_len=80, buckets=(8, 16),
+                          prefill_batch=2, page_size=8,
+                          max_pages_per_request=10, num_pages=30,
+                          max_concurrency=8, n_requests=8,
+                          lo_new=2, hi_new=6, span_warm_new=30,
+                          workload_span=24)
     else:
         cfg = GptConfig(vocab_size=8192, hidden_size=256,
                         num_hidden_layers=8, num_attention_heads=8,
@@ -553,6 +653,15 @@ def main() -> int:
                         max_concurrency=12, n_requests=16,
                         lo_new=32, hi_new=64,
                         spec_k=10, draft_blocks=1, vocab_size=1024)
+        # kernel/quant A/B: an 18-page table serving <= 7-page live
+        # spans — the regime where PR 9's full-width gather pays for
+        # table CAPACITY while the bounded gather pays for live tokens
+        kernel_cfg = dict(slots=4, max_len=288, buckets=(16, 32, 64),
+                          prefill_batch=2, page_size=16,
+                          max_pages_per_request=18, num_pages=96,
+                          max_concurrency=12, n_requests=16,
+                          lo_new=6, hi_new=40, span_warm_new=100,
+                          workload_span=104)
 
     layer_cfgs = gpt_layer_configs(cfg, deterministic=True)
     n_layers = len(layer_cfgs)
@@ -601,11 +710,12 @@ def main() -> int:
         },
     }
     ok = True
-    any_flag = args.paged or args.chunked or args.spec
+    any_flag = args.paged or args.chunked or args.spec or args.kernel
     do_cvs = not any_flag
     do_paged = args.paged or (not any_flag and not args.smoke)
     do_chunked = args.chunked or (not any_flag and not args.smoke)
     do_spec = args.spec or (not any_flag and not args.smoke)
+    do_kernel = args.kernel or (not any_flag and not args.smoke)
 
     if do_cvs:
         report["bench"] = "serving_continuous_vs_static"
@@ -974,6 +1084,205 @@ def main() -> int:
         ok = ok and all(sgates.values())
         print(f"speculative speedup: {speedup:.2f}x at accept_rate="
               f"{sres['speculative']['accept_rate']}; gates: {sgates}",
+              flush=True)
+
+    if do_kernel:
+        # ---- fused kernel + int8-quantized KV pages ----
+        from skycomputing_tpu.serving import paged_pool_mb
+
+        kcfg = dict(kernel_cfg)
+        kcfg["partition"] = partition
+        fwd_k = jax.jit(lambda ids: stack.apply(params, ids))
+        rng_k = np.random.default_rng(args.seed + 5)
+        kspecs = build_workload(
+            rng_k, kcfg["n_requests"], list(kcfg["buckets"]),
+            kcfg["workload_span"], kcfg["lo_new"], kcfg["hi_new"],
+        )
+
+        kres = {}
+        kouts = {}
+        for name, kw in (
+            ("full_gather", dict(gather="full")),
+            ("live_gather", dict(gather="live")),
+            ("int8", dict(gather="live", kv_dtype="int8")),
+        ):
+            print(f"running kernel-section {name} run...", flush=True)
+            result, outs, requests = run_kernel_engine(
+                layer_cfgs, params, kspecs, kcfg, **kw
+            )
+            kres[name] = result
+            kouts[name] = (outs, requests)
+            per_tok = result["decode_s_per_token"]
+            print(f"  {name}: decode "
+                  f"{(per_tok or 0) * 1e3:.2f}ms/token "
+                  f"({result['decode_s']:.2f}s total), "
+                  f"recompiles={result['steady_state_compiles']}",
+                  flush=True)
+
+        def one_shot_k(r):
+            return generate(
+                fwd_k, r.prompt[None], max_new_tokens=r.max_new_tokens,
+                context_length=kcfg["max_len"],
+            )[0]
+
+        l_outs, l_reqs = kouts["live_gather"]
+        f_outs, f_reqs = kouts["full_gather"]
+        live_identical = all(
+            np.array_equal(l_outs[r.request_id], one_shot_k(r))
+            for r in l_reqs
+        )
+        live_vs_full = all(
+            np.array_equal(l_outs[lr.request_id], f_outs[fr.request_id])
+            for lr, fr in zip(l_reqs, f_reqs)
+        )
+        # int8 is bounded-error by design: gate the greedy STREAM
+        # agreement (positional, generated tokens only — compounding
+        # divergence after one near-tie flip is charged honestly) and
+        # the first generated token (prefill-logit fidelity)
+        i_outs, i_reqs = kouts["int8"]
+        agree = total = first = 0
+        for lr, ir in zip(l_reqs, i_reqs):
+            x = l_outs[lr.request_id][len(lr.prompt):]
+            y = i_outs[ir.request_id][len(ir.prompt):]
+            agree += int((x == y).sum())
+            total += int(x.size)
+            first += int(x[0] == y[0])
+        agreement = agree / total if total else None
+        first_frac = first / len(kspecs)
+        spec0 = None
+        for cfg_i in layer_cfgs:
+            if cfg_i.get("layer_type") == "GptBlock_Attn":
+                spec0 = cfg_i["config"]
+                break
+        heads = int(spec0["num_attention_heads"])
+        head_dim = int(spec0["hidden_size"]) // heads
+        mb_fp = paged_pool_mb(
+            kcfg["num_pages"], kcfg["page_size"], heads, head_dim,
+            kv_dtype=str(spec0.get("dtype", "float32")),
+        )
+        mb_i8 = paged_pool_mb(
+            kcfg["num_pages"], kcfg["page_size"], heads, head_dim,
+            kv_dtype="int8",
+        )
+        pages_ratio = mb_fp / mb_i8  # pages/MB gain at equal pool MB
+
+        # pallas validation leg: its own TINY instance (interpret-mode
+        # Pallas on CPU is a correctness surface, orders slower than
+        # XLA — running it on the bench model would measure the
+        # interpreter, not the kernel; the operating point is stamped)
+        print("running pallas interpret validation leg...", flush=True)
+        from skycomputing_tpu.builder import (
+            build_layer_stack as _bls,
+        )
+        v_model = GptConfig(vocab_size=512, hidden_size=64,
+                            num_hidden_layers=2, num_attention_heads=2,
+                            max_position_embeddings=64,
+                            dropout_prob=0.0, dtype="float32")
+        v_layer_cfgs = gpt_layer_configs(v_model, deterministic=True)
+        v_stack = _bls(v_layer_cfgs)
+        v_params = v_stack.init(
+            jax.random.key(args.seed + 6), np.ones((1, 8), np.int32)
+        )
+        v_kcfg = dict(slots=2, max_len=32, buckets=(8,),
+                      prefill_batch=1, partition=None, page_size=8,
+                      max_pages_per_request=4, num_pages=12,
+                      max_concurrency=2, span_warm_new=20)
+        v_rng = np.random.default_rng(args.seed + 7)
+        v_specs = [
+            (v_rng.integers(1, 512, (l,)).astype(np.int32), n)
+            for l, n in ((5, 4), (3, 3))
+        ]
+        pallas_res, p_outs, p_reqs = run_kernel_engine(
+            v_layer_cfgs, v_params, v_specs, v_kcfg,
+            attn_impl="pallas",
+        )
+        xla_res, x_outs, x_reqs = run_kernel_engine(
+            v_layer_cfgs, v_params, v_specs, v_kcfg, attn_impl="xla",
+        )
+        pallas_identical = all(
+            np.array_equal(p_outs[pr.request_id], x_outs[xr.request_id])
+            for pr, xr in zip(p_reqs, x_reqs)
+        )
+
+        kgates = {
+            "live_token_identical": bool(live_identical),
+            "live_matches_full_gather": bool(live_vs_full),
+            "pallas_matches_xla": bool(pallas_identical),
+            "zero_steady_state_recompiles_xla": (
+                kres["live_gather"]["steady_state_compiles"] == 0
+            ),
+            "zero_steady_state_recompiles_pallas": (
+                pallas_res["steady_state_compiles"] == 0
+            ),
+            "zero_steady_state_recompiles_int8": (
+                kres["int8"]["steady_state_compiles"] == 0
+            ),
+            "pages_per_mb_gain_over_1_9x": bool(pages_ratio >= 1.9),
+            "int8_agreement_over_0_7": bool(
+                agreement is not None and agreement >= 0.7
+            ),
+            "int8_first_token_over_0_9": bool(first_frac >= 0.9),
+            "quant_counters_move": bool(
+                kres["int8"]["quantized_pages"] > 0
+                and kres["int8"]["dequant_blocks"] > 0
+            ),
+        }
+        if not args.smoke:
+            # timing gate: the bounded gather's decode tick must beat
+            # the materializing full-width gather at equal workload —
+            # only meaningful when per-tick costs dwarf scheduler noise
+            ful = kres["full_gather"]["decode_s_per_token"]
+            liv = kres["live_gather"]["decode_s_per_token"]
+            kgates["decode_tick_improves"] = bool(
+                ful is not None and liv is not None and liv < ful
+            )
+        decode_speedup = None
+        if (kres["full_gather"]["decode_s_per_token"]
+                and kres["live_gather"]["decode_s_per_token"]):
+            decode_speedup = (
+                kres["full_gather"]["decode_s_per_token"]
+                / kres["live_gather"]["decode_s_per_token"]
+            )
+        report["kernel_quant"] = {
+            "operating_point": {
+                k: kcfg[k]
+                for k in ("page_size", "num_pages",
+                          "max_pages_per_request", "max_concurrency",
+                          "prefill_batch", "workload_span")
+            },
+            "workload": {
+                "requests": len(kspecs),
+                "prompt_lengths": [int(len(p)) for p, _ in kspecs],
+                "new_tokens": [int(n) for _, n in kspecs],
+            },
+            "full_gather": kres["full_gather"],
+            "live_gather": kres["live_gather"],
+            "int8": kres["int8"],
+            "decode_per_token_speedup": decode_speedup,
+            "pool_mb_fp": mb_fp,
+            "pool_mb_int8": mb_i8,
+            "pages_per_mb_gain": pages_ratio,
+            "int8_agreement": agreement,
+            "int8_first_token_agreement": first_frac,
+            "pallas_leg": {
+                "note": ("interpret-mode correctness surface on its "
+                         "own tiny instance; the compiled kernel "
+                         "needs a TPU"),
+                "model": {"hidden_size": v_model.hidden_size,
+                          "num_hidden_layers":
+                              v_model.num_hidden_layers,
+                          "vocab_size": v_model.vocab_size},
+                "pallas": pallas_res,
+                "xla": xla_res,
+            },
+            "gates": kgates,
+        }
+        ok = ok and all(kgates.values())
+        print(f"kernel/quant: decode speedup "
+              f"{f'{decode_speedup:.2f}x' if decode_speedup else 'n/a'} "
+              f"(live vs full gather), pages/MB {pages_ratio:.2f}x, "
+              f"int8 agreement {agreement:.3f} "
+              f"(first-token {first_frac:.2f}); gates: {kgates}",
               flush=True)
 
     with open(args.out, "w") as f:

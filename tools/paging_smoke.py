@@ -117,6 +117,53 @@ def main() -> int:
     check(rows.used_slots == 2 and {a, b} == {0, 1},
           "acquire/release round-trips specific rows")
 
+    print("int8 page accounting (kv_dtype allocator policy):")
+    heads, head_dim = 8, 32
+    mb_fp = P.paged_pool_mb(48, 16, heads, head_dim,
+                            kv_dtype="float32")
+    mb_i8 = P.paged_pool_mb(48, 16, heads, head_dim, kv_dtype="int8")
+    check(mb_i8 < mb_fp, "int8 pool is smaller at equal pages")
+    scale_mb = 2.0 * 48 * heads * 4 / 1024.0 ** 2
+    values_mb = 2.0 * 48 * 16 * heads * head_dim / 1024.0 ** 2
+    check(abs(mb_i8 - (values_mb + scale_mb)) < 1e-12,
+          "scale-slab bytes are counted (values + [pages, heads] f32)")
+    ratio = (P.pages_per_mb(16, heads, head_dim, kv_dtype="int8")
+             / P.pages_per_mb(16, heads, head_dim, kv_dtype="float16"))
+    check(ratio >= 1.9,
+          f"pages/MB doubles vs fp16 ({ratio:.2f}x, scale slab "
+          f"included)")
+    try:
+        P.paged_pool_mb(1, 16, heads, head_dim, kv_dtype="int4")
+        check(False, "unknown kv_dtype must raise")
+    except ValueError:
+        check(True, "unknown kv_dtype raises (no silent drift)")
+
+    print("int8 COW plan copies scales with data:")
+    qpool = P.PagedKVCachePool(num_pages=8, page_size=4,
+                               max_pages_per_request=6,
+                               kv_dtype="int8")
+    check(qpool.kv_dtype == "int8", "pool carries its storage dtype")
+    check(qpool.pool_mb(heads, head_dim)
+          == P.paged_pool_mb(8, 4, heads, head_dim, kv_dtype="int8"),
+          "pool_mb is the shared quantized-width formula")
+    g1 = qpool.acquire(1, list(range(10)), 15)
+    qpool.register_prefix(1, list(range(10)))
+    g2 = qpool.acquire(2, list(range(10)) + [99], 12)
+    plan = qpool.cow_plan(g2)
+    check(("values", g2.cow_src, g2.cow_dst) in plan
+          and ("scales", g2.cow_src, g2.cow_dst) in plan,
+          "COW clone plan names the scale row alongside the values")
+    fpool = P.PagedKVCachePool(num_pages=8, page_size=4,
+                               max_pages_per_request=6)
+    f1 = fpool.acquire(1, list(range(10)), 15)
+    fpool.register_prefix(1, list(range(10)))
+    f2 = fpool.acquire(2, list(range(10)) + [99], 12)
+    check(fpool.cow_plan(f2) == [("values", f2.cow_src, f2.cow_dst)],
+          "fp pools plan no scale copy")
+    check(qpool.cow_plan(g1) == [],
+          "a grant without COW plans nothing")
+    qpool.check_consistency()
+
     print("preemption-mode policy:")
     check(P.choose_preempt_mode(4, 1, 16) == "recompute",
           "short resume prefixes recompute (cheap prefill replay)")
