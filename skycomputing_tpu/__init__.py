@@ -35,7 +35,7 @@ from .dynamics import (
     WorkerManager,
 )
 from .fleet import FleetSupervisor, Router, ServingFleet
-from .parallel import PipelineModel, StageRuntime
+from .parallel import MeshPipelineModel, PipelineModel, StageRuntime
 from .runner import AutotuneHook, Hook, Runner
 from .serving import (
     ChunkBudgetPolicy,
@@ -86,6 +86,7 @@ __all__ = [
     "ParameterServer",
     "Worker",
     "WorkerManager",
+    "MeshPipelineModel",
     "PipelineModel",
     "StageRuntime",
     "Hook",

@@ -39,6 +39,7 @@ from .plan_check import (
     PlanReport,
     has_plan,
     verify_allocation_payload,
+    verify_mesh_payload,
     verify_pipeline,
     verify_plan,
     verify_tuning_knobs,
@@ -59,6 +60,7 @@ __all__ = [
     "PlanReport",
     "has_plan",
     "verify_allocation_payload",
+    "verify_mesh_payload",
     "verify_pipeline",
     "verify_plan",
     "verify_tuning_knobs",

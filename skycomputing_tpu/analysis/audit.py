@@ -195,6 +195,9 @@ MANIFEST: Dict[str, Any] = {
     "pure_stdlib": [
         "skycomputing_tpu.analysis.audit",
         "skycomputing_tpu.analysis.lint",
+        # the partition/mesh-shape solver: pure math by contract, so
+        # tools/mesh_smoke.py can file-path-load it on a bare lint runner
+        "skycomputing_tpu.dynamics.solver",
         "skycomputing_tpu.fleet.admission",
         "skycomputing_tpu.fleet.router",
         "skycomputing_tpu.serving.paging",
@@ -212,6 +215,9 @@ MANIFEST: Dict[str, Any] = {
         "tools.bench_fleet",
         "tools.changed",
         "tools.chunk_smoke",
+        # mesh-shape-search contracts (file-path-loads dynamics/solver);
+        # its jax section self-SKIPs on bare runners
+        "tools.mesh_smoke",
         "tools.metrics_report",
         # jax-needing smoke, but its ENTRY must still start stdlib-only
         # (the jax import lives inside main() behind a SKIP) so a bare

@@ -858,6 +858,93 @@ def verify_allocation_payload(payload: Any) -> List[str]:
     serving = payload.get("serving")
     if serving is not None:
         problems.extend(_verify_serving_payload(serving))
+    mesh = payload.get("mesh")
+    if mesh is not None:
+        problems.extend(verify_mesh_payload(mesh))
+    return problems
+
+
+def verify_mesh_payload(mesh: Any) -> List[str]:
+    """Problems with a mesh operating point (empty = valid).
+
+    Schema — what ``Allocator.mesh_allocate`` emits and the mesh-native
+    engine consumes: ``chips_per_stage`` (required, non-empty list of
+    positive ints — one sub-mesh width per pipeline stage),
+    ``num_devices`` (required, positive int; the chips must fit:
+    ``sum(chips_per_stage) <= num_devices``), optional ``tp`` (positive
+    int dividing every stage's chips — each sub-mesh reshapes to
+    ``(chips/tp, tp)``), optional ``microbatch_rows`` (positive int;
+    every stage's dp = chips/tp must divide it, or the engine rejects
+    the very first ``compute_gradients`` AFTER the plan was committed —
+    callers that know the live batch shape must pass it so the reshape
+    dies at verify time, not mid-training).  This is the
+    verify-then-apply contract a mesh reshape passes through before
+    ``rebuild()`` (AutotuneHook), and the schema a staged re-form
+    payload's ``mesh`` key validates against.
+    """
+    problems: List[str] = []
+    if not isinstance(mesh, dict):
+        return [
+            f"mesh operating point must be an object, got "
+            f"{type(mesh).__name__}"
+        ]
+    chips = mesh.get("chips_per_stage")
+    if not isinstance(chips, list) or not chips:
+        problems.append(
+            "mesh.chips_per_stage must be a non-empty list of positive "
+            f"ints, got {chips!r}"
+        )
+        chips = []
+    for i, k in enumerate(chips):
+        if not _pos_int(k):
+            problems.append(
+                f"mesh.chips_per_stage[{i}] = {k!r} is not a positive int"
+            )
+    devices = mesh.get("num_devices")
+    if not _pos_int(devices):
+        problems.append(
+            f"mesh.num_devices must be a positive int, got {devices!r}"
+        )
+    elif chips and all(_pos_int(k) for k in chips) and \
+            sum(chips) > devices:
+        problems.append(
+            f"mesh shape {chips} needs {sum(chips)} chips but "
+            f"num_devices is {devices} — the sub-mesh slices must fit "
+            f"the global device order"
+        )
+    tp = mesh.get("tp")
+    if tp is not None:
+        if not _pos_int(tp):
+            problems.append(
+                f"mesh.tp must be a positive int, got {tp!r}"
+            )
+        else:
+            for i, k in enumerate(chips):
+                if _pos_int(k) and k % tp:
+                    problems.append(
+                        f"mesh.chips_per_stage[{i}] = {k} is not "
+                        f"divisible by tp={tp}"
+                    )
+    rows = mesh.get("microbatch_rows")
+    if rows is not None:
+        if not _pos_int(rows):
+            problems.append(
+                f"mesh.microbatch_rows must be a positive int, got "
+                f"{rows!r}"
+            )
+        else:
+            tp_div = tp if _pos_int(tp) else 1
+            for i, k in enumerate(chips):
+                if not (_pos_int(k) and k % tp_div == 0):
+                    continue
+                dp = k // tp_div
+                if rows % dp:
+                    problems.append(
+                        f"mesh.chips_per_stage[{i}] gives dp={dp}, "
+                        f"which does not divide the {rows} microbatch "
+                        f"rows — the engine would reject the first "
+                        f"step after this plan committed"
+                    )
     return problems
 
 
@@ -1102,6 +1189,7 @@ __all__ = [
     "PlanReport",
     "has_plan",
     "verify_allocation_payload",
+    "verify_mesh_payload",
     "verify_pipeline",
     "verify_plan",
     "verify_tuning_knobs",

@@ -7,7 +7,12 @@ from .benchmarker import (
 )
 from .estimator import Estimator
 from .parameter_server import ParameterServer
-from .solver import PartitionResult, solve_contiguous_minmax
+from .solver import (
+    MeshShapeResult,
+    PartitionResult,
+    solve_contiguous_minmax,
+    solve_mesh_shapes,
+)
 from .worker import Worker
 from .worker_manager import WorkerManager
 
@@ -31,8 +36,10 @@ __all__ = [
     "device_available_memory_mb",
     "Estimator",
     "ParameterServer",
+    "MeshShapeResult",
     "PartitionResult",
     "solve_contiguous_minmax",
+    "solve_mesh_shapes",
     "Worker",
     "WorkerManager",
 ]

@@ -1,6 +1,12 @@
 from .data_parallel import DataParallelPipeline
 from .expert_parallel import ep_shardings, make_ep_mesh, shard_moe_params
-from .mesh import make_dp_pp_mesh, make_dp_pp_tp_mesh, make_pipeline_mesh
+from .mesh import (
+    make_dp_pp_mesh,
+    make_dp_pp_tp_mesh,
+    make_pipeline_mesh,
+    stage_submeshes,
+)
+from .mesh_pipeline import MeshPipelineModel, MeshStageRuntime
 from .heartbeat import PeerHeartbeat
 from .multihost import global_mesh, initialize_from_env, is_coordinator
 from .ring_attention import full_attention_reference, ring_attention
@@ -31,6 +37,9 @@ __all__ = [
     "make_dp_pp_mesh",
     "make_dp_pp_tp_mesh",
     "make_pipeline_mesh",
+    "stage_submeshes",
+    "MeshPipelineModel",
+    "MeshStageRuntime",
     "PipelineModel",
     "PipelineStats",
     "StageRuntime",

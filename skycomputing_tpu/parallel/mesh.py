@@ -59,9 +59,53 @@ def make_dp_pp_tp_mesh(
     return Mesh(grid, axis_names=("dp", "pp", "tp"))
 
 
+def stage_submeshes(
+    chips_per_stage: Sequence[int],
+    devices: Optional[Sequence] = None,
+    tp: int = 1,
+    axis_names: Tuple[str, str] = ("dp", "tp"),
+) -> list:
+    """Contiguous sub-mesh slices of ONE global device order.
+
+    Stage ``i`` owns the contiguous block
+    ``devices[sum(chips[:i]) : sum(chips[:i+1])]`` reshaped to
+    ``(chips_i // tp, tp)`` under named axes ``('dp', 'tp')`` — the
+    mesh-native engine places each stage's single program on exactly one
+    of these slices, so chips-per-stage is an allocator output instead
+    of a hardcoded 1.  Contiguity keeps stage handoffs neighbor-local on
+    a real ICI topology (and is what makes the slices sub-meshes of one
+    global mesh rather than arbitrary device subsets).
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    chips = [int(k) for k in chips_per_stage]
+    if not chips:
+        raise ValueError("chips_per_stage is empty")
+    if any(k < 1 for k in chips):
+        raise ValueError(f"chips_per_stage must be >= 1, got {chips}")
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    need = sum(chips)
+    if need > len(devs):
+        raise ValueError(
+            f"mesh shape {chips} needs {need} devices, have {len(devs)}"
+        )
+    meshes = []
+    offset = 0
+    for i, k in enumerate(chips):
+        if k % tp:
+            raise ValueError(
+                f"stage {i}: {k} chips not divisible by tp={tp}"
+            )
+        block = np.array(devs[offset:offset + k]).reshape(k // tp, tp)
+        meshes.append(Mesh(block, axis_names=tuple(axis_names)))
+        offset += k
+    return meshes
+
+
 __all__ = [
     "make_1d_mesh",
     "make_pipeline_mesh",
     "make_dp_pp_mesh",
     "make_dp_pp_tp_mesh",
+    "stage_submeshes",
 ]

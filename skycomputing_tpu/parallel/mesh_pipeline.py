@@ -1,0 +1,396 @@
+"""Mesh-native stage execution: per-stage NamedSharding programs on one
+global device order.
+
+The MPMD engine (:mod:`.pipeline`) drives one program per DEVICE per
+microbatch from its Python issue loop — on small steps 75-88% of the
+step is host dispatch (BENCH_pr2_hotpath.json), and a stage can never
+span more than one chip.  This engine keeps the paper's unequal
+layer->stage allocation but runs every stage as ONE ``jax.jit`` program
+placed on a contiguous sub-mesh slice of the global device order
+(:func:`.mesh.stage_submeshes`): 1..K chips per stage with named
+``('dp', 'tp')`` axes inside the stage, parameters replicated over the
+sub-mesh via ``NamedSharding(mesh, P())`` and microbatch rows sharded
+over ``'dp'``.  What changes relative to the per-device loop:
+
+- **dispatch collapses from O(devices) to O(stages) per microbatch
+  tick** — chips-per-stage becomes an allocator output
+  (``dynamics.solver.solve_mesh_shapes``) instead of a hardcoded 1, the
+  per-(microbatch, stage) rng table is built by ONE jitted fold per step
+  and committed per stage (M x S host folds become 1 program + S puts,
+  identical threefry bits), and backward + gradient accumulation fuse
+  into one program per (microbatch, stage);
+- **activation handoff is device_put-to-sharding**: the schedules'
+  ``device_put_elided`` calls target the next stage's input
+  ``NamedSharding`` — XLA owns placement and layout, one batched put per
+  boundary, elision when producer and consumer share a sharding.  The
+  hand-rolled transfer-elision/donation counters stay as observability
+  over the new path;
+- **the schedules are unchanged**: this class subclasses
+  :class:`~.pipeline.PipelineModel` and reuses its gpipe/1f1b issue
+  loops verbatim — on the same allocation at one chip per stage the two
+  engines produce bitwise-identical gradients and parameters (gated in
+  ``BENCH_mesh_pipeline.json`` and ``tests/test_mesh_pipeline.py``).
+
+Chips-per-stage comes from the workers' ``extra_config['mesh_chips']``
+(written by ``Allocator.mesh_allocate``) or an explicit
+``chips_per_stage`` argument; stages take contiguous device blocks in
+pipeline order.  Sub-mesh programs run their chips in lockstep, so the
+mesh engine targets homogeneous pods — per-device heterogeneity remains
+the MPMD engine's domain (see docs/design.md's decision table).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from functools import partial
+
+from ..builder import as_tuple
+from .mesh import stage_submeshes
+from .pipeline import (
+    _DISPATCH_STATS,
+    PipelineModel,
+    StageRuntime,
+    _donation_enabled,
+    _StagePrograms,
+    cached_programs,
+    device_put_elided,
+)
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _fold_table(rng, M: int, S: int):
+    """All M x S per-(microbatch, stage) keys in one program — the same
+    pair-fold threefry math as ``pipeline._fold2``, M x S fewer
+    dispatches."""
+    return [
+        [
+            jax.random.fold_in(jax.random.fold_in(rng, m), k)
+            for k in range(S)
+        ]
+        for m in range(M)
+    ]
+
+
+class _MeshStagePrograms(_StagePrograms):
+    """``_StagePrograms`` plus the fused backward+accumulate programs.
+
+    The fwd/bwd/update math is the PARENT's raw closures verbatim (one
+    definition — the bitwise-equivalence contract between the engines
+    cannot drift), with two mesh-specific notes: the rng operand is a
+    plain key PRE-COMMITTED to the stage's sub-mesh by the engine's
+    per-step rng table (an uncommitted key pays a per-call resharding
+    transfer ~7x the program's own dispatch cost on the multi-device
+    path), and ``bwd_acc`` fuses gradient accumulation so one program
+    per (microbatch, stage) covers what the MPMD engine issues as two.
+    Placement comes from the COMMITTED operands (params/inputs carry
+    their stage's NamedSharding), so one program object serves every
+    stage with this structure — jit caches one executable per distinct
+    sub-mesh.
+    """
+
+    def __init__(self, layer_cfgs, optimizer):
+        super().__init__(layer_cfgs, optimizer)
+        bwd, bwd_params_only = self._raw_bwd, self._raw_bwd_params_only
+
+        # fused backward + accumulate: `total is None` is static per
+        # pytree structure, so the first microbatch traces the no-add
+        # variant and later microbatches the adding one — two traces of
+        # ONE function, still one invocation per (microbatch, stage).
+        # The adds are the same elementwise jnp.add the MPMD grad_add
+        # program runs, so accumulation order (and bits) are identical.
+        def bwd_acc(params, inputs, rng, dy, total):
+            dparams, dx = bwd(params, inputs, rng, dy)
+            if total is not None:
+                dparams = jax.tree_util.tree_map(jnp.add, total, dparams)
+            return dparams, dx
+
+        def bwd_acc_params_only(params, inputs, rng, dy, total):
+            dparams = bwd_params_only(params, inputs, rng, dy)
+            if total is not None:
+                dparams = jax.tree_util.tree_map(jnp.add, total, dparams)
+            return dparams, None
+
+        # donation invariants as in pipeline.py: the stored input tuple
+        # dies when its backward issues, the running total is rebound to
+        # the fused program's output; dy is never donated (shared cached
+        # zero tail).  The parent's undonated bwd/bwd_params_only twins
+        # remain the profiling programs (measure_stage_times re-executes
+        # with the same buffers).
+        if _donation_enabled():
+            self.bwd_acc = jax.jit(bwd_acc, donate_argnums=(1, 4))
+            self.bwd_acc_params_only = jax.jit(
+                bwd_acc_params_only, donate_argnums=(1, 4)
+            )
+        else:
+            self.bwd_acc = jax.jit(bwd_acc)
+            self.bwd_acc_params_only = jax.jit(bwd_acc_params_only)
+
+
+def get_mesh_stage_programs(layer_cfgs, optimizer) -> _MeshStagePrograms:
+    """Mesh-native twin of ``get_stage_programs`` — shares the bounded
+    process-global LRU (and its hit/miss counters) under a ``"mesh"``
+    key prefix, so the two engines' program structures compete for the
+    same capped executable budget."""
+    key = (
+        "mesh",
+        json.dumps(list(layer_cfgs), sort_keys=True, default=str),
+        id(optimizer),
+        _donation_enabled(),
+    )
+    return cached_programs(
+        key, lambda: _MeshStagePrograms(layer_cfgs, optimizer)
+    )
+
+
+class MeshStageRuntime(StageRuntime):
+    """One mesh-native stage: layer slice + contiguous sub-mesh + one
+    compiled program per phase, placed by ``NamedSharding``.
+
+    ``device`` IS the stage's input sharding (microbatch rows over
+    ``'dp'``): the schedule loops hand activations off with
+    ``device_put_elided(acts, stage.device)``, so the same loops drive
+    device-committed (MPMD) and sharding-committed (mesh) stages.
+    """
+
+    def __init__(
+        self,
+        stage_index: int,
+        layer_cfgs: Sequence[Dict],
+        params: Sequence[Any],
+        submesh,
+        optimizer: optax.GradientTransformation,
+        slowdown: float = 1.0,
+        differentiable_inputs: bool = True,
+    ):
+        self.stage_index = stage_index
+        self.mesh = submesh
+        self.num_layers = len(layer_cfgs)
+        self.dp = int(submesh.shape["dp"])
+        self.tp = int(submesh.shape["tp"])
+        self.param_sharding = NamedSharding(submesh, P())
+        self.batch_sharding = NamedSharding(submesh, P("dp"))
+        self.device = self.batch_sharding
+        devs = list(submesh.devices.flatten())
+        # keep the "stage N" prefix: tools/trace_report.py keys stage
+        # utilization on it
+        self.lane_name = (
+            f"stage {stage_index} [{devs[0]}x{len(devs)} dp={self.dp}"
+            f" tp={self.tp}]"
+        )
+        self.slowdown = float(slowdown)
+        self._differentiable_inputs = differentiable_inputs
+        self.config_key = json.dumps(list(layer_cfgs), sort_keys=True,
+                                     default=str)
+
+        programs = get_mesh_stage_programs(layer_cfgs, optimizer)
+        self.stack = programs.stack
+        self._fwd = programs.fwd
+        self._bwd = programs.bwd
+        self._bwd_params_only = programs.bwd_params_only
+        self._bwd_acc = programs.bwd_acc
+        self._bwd_acc_params_only = programs.bwd_acc_params_only
+        self._update = programs.update
+        self._optimizer = optimizer
+
+        self.params: List[Any] = jax.device_put(
+            list(params), self.param_sharding
+        )
+        self.opt_state = jax.device_put(
+            optimizer.init(self.params), self.param_sharding
+        )
+
+    # --- execution ----------------------------------------------------------
+    def forward_placed(self, inputs, rng):
+        _DISPATCH_STATS["programs"] += 1
+        out = self._fwd(self.params, inputs, rng)
+        self._emulate_slowdown(out)
+        return out
+
+    def backward_accumulate(self, total, inputs, rng, dy):
+        """ONE fused program: backward for this microbatch plus
+        accumulation into the running grad total (vs the MPMD engine's
+        bwd + grad_add pair) — same values, same bits, half the issue
+        calls."""
+        dy = device_put_elided(dy, self.device)
+        _DISPATCH_STATS["programs"] += 1
+        if self._differentiable_inputs:
+            new_total, dx = self._bwd_acc(
+                self.params, inputs, rng, dy, total
+            )
+        else:
+            new_total, dx = self._bwd_acc_params_only(
+                self.params, inputs, rng, dy, total
+            )
+        self._emulate_slowdown(new_total)
+        return new_total, dx
+
+    def backward(self, inputs, rng, dy):  # pragma: no cover - guard
+        raise NotImplementedError(
+            "mesh stages fuse backward+accumulate; drive them through "
+            "backward_accumulate (the schedule loops do)"
+        )
+
+    def accumulate(self, total, grads):  # pragma: no cover - guard
+        raise NotImplementedError(
+            "mesh stages fuse backward+accumulate; drive them through "
+            "backward_accumulate (the schedule loops do)"
+        )
+
+    # --- weights exchange ---------------------------------------------------
+    def load_weights(self, state_dict_list: Sequence[Any]) -> None:
+        if len(state_dict_list) != self.num_layers:
+            raise ValueError(
+                f"stage {self.stage_index} holds {self.num_layers} layers, "
+                f"got {len(state_dict_list)} state dicts"
+            )
+        self.params = jax.device_put(
+            list(state_dict_list), self.param_sharding
+        )
+        self.opt_state = jax.device_put(
+            self._optimizer.init(self.params), self.param_sharding
+        )
+
+
+class MeshPipelineModel(PipelineModel):
+    """The mesh-native pipeline: stage runtimes on sub-mesh slices.
+
+    Same constructor contract as :class:`~.pipeline.PipelineModel`
+    (stage slices come from the worker manager's allocation; parameters
+    from the layer-indexed parameter server), plus chips-per-stage:
+    read from each staged worker's ``extra_config['mesh_chips']`` when
+    present (the ``Allocator.mesh_allocate`` /
+    ``refine_mesh_allocation`` output — ``rebuild()`` re-reads it, so a
+    mesh reshape applies through the same verify-then-apply rebuild path
+    as an MPMD re-allocation), else from the ``chips_per_stage``
+    argument, else one chip per stage.  Devices are consumed as
+    contiguous blocks of ``devices`` in pipeline order.
+    """
+
+    def __init__(
+        self,
+        worker_manager,
+        parameter_server,
+        optimizer: optax.GradientTransformation,
+        loss_fn,
+        devices: Optional[Sequence[Any]] = None,
+        num_microbatches: int = 1,
+        schedule: str = "gpipe",
+        chips_per_stage: Optional[Sequence[int]] = None,
+        tp: int = 1,
+    ):
+        self._chips_override = (
+            [int(k) for k in chips_per_stage]
+            if chips_per_stage is not None else None
+        )
+        self._tp = int(tp)
+        super().__init__(
+            worker_manager, parameter_server, optimizer, loss_fn,
+            devices=devices, num_microbatches=num_microbatches,
+            schedule=schedule,
+        )
+
+    # --- construction -------------------------------------------------------
+    def _build_stages(self) -> None:
+        self.stages = []
+        workers = sorted(
+            self._worker_manager.worker_pool, key=lambda w: w.rank
+        )
+        staged = [w for w in workers if w.model_config]
+        if any("mesh_chips" in w.extra_config for w in staged):
+            # the allocator owns the mesh shape: a reshape rewrites
+            # extra_config and rebuild() picks it up here
+            chips = [
+                int(w.extra_config.get("mesh_chips", 1)) for w in staged
+            ]
+        elif self._chips_override is not None:
+            chips = list(self._chips_override)
+            if len(chips) != len(staged):
+                raise ValueError(
+                    f"chips_per_stage has {len(chips)} entries for "
+                    f"{len(staged)} staged workers"
+                )
+        else:
+            chips = [1] * len(staged)
+        meshes = stage_submeshes(chips, self._devices, tp=self._tp)
+        layer_cursor = 0
+        for i, (worker, submesh) in enumerate(zip(staged, meshes)):
+            layer_cfgs = worker.model_config
+            params = self._parameter_server.get_layer_slice(
+                layer_cursor, layer_cursor + len(layer_cfgs)
+            )
+            self.stages.append(
+                MeshStageRuntime(
+                    stage_index=i,
+                    layer_cfgs=layer_cfgs,
+                    params=params,
+                    submesh=submesh,
+                    optimizer=self._optimizer,
+                    slowdown=float(worker.extra_config.get("slowdown", 1.0)),
+                    differentiable_inputs=i > 0,
+                )
+            )
+            layer_cursor += len(layer_cfgs)
+        if layer_cursor != self._parameter_server.num_layers:
+            raise ValueError(
+                f"workers cover {layer_cursor} layers but the model has "
+                f"{self._parameter_server.num_layers} — run an allocator "
+                f"first"
+            )
+
+    @property
+    def chips_per_stage(self) -> List[int]:
+        """Chips owned by each stage, pipeline order (dp x tp)."""
+        return [s.dp * s.tp for s in self.stages]
+
+    # --- execution ----------------------------------------------------------
+    def _step_rngs(self, rng, M: int, S: int):
+        """The whole (microbatch, stage) key table in ONE jitted fold,
+        then one batched put per stage committing its column replicated
+        onto the stage's sub-mesh.
+
+        Two costs die here: the MPMD path's M x S per-cell fold
+        dispatches become 1 + S, and — the expensive one — stage
+        programs never see an UNCOMMITTED key operand (each call would
+        pay a resharding transfer onto the sub-mesh ~7x the program's
+        own dispatch cost).  The fold math is the same
+        ``fold_in(fold_in(rng, m), k)`` pair-fold, so seeded runs replay
+        the MPMD engine's masks bit-for-bit.
+        """
+        _DISPATCH_STATS["programs"] += 1
+        table = _fold_table(rng, M, S)
+        columns = []
+        for k, stage in enumerate(self.stages):
+            _DISPATCH_STATS["puts"] += 1
+            columns.append(jax.device_put(
+                [table[m][k] for m in range(M)], stage.param_sharding
+            ))
+        return [[columns[k][m] for k in range(S)] for m in range(M)]
+
+    def compute_gradients(self, data, labels, rng=None, block: bool = True):
+        leaves = jax.tree_util.tree_leaves(as_tuple(data))
+        # np.shape reads host metadata only — no device sync
+        rows = np.shape(leaves[0])[0] // max(self.num_microbatches, 1)
+        bad = [s for s in self.stages if rows % s.dp]
+        if bad:
+            raise ValueError(
+                f"microbatch rows {rows} not divisible by stage "
+                f"{bad[0].stage_index}'s dp={bad[0].dp} — pick "
+                f"num_microbatches/chips so every stage's dp divides "
+                f"the microbatch"
+            )
+        return super().compute_gradients(data, labels, rng, block)
+
+
+__all__ = [
+    "MeshPipelineModel",
+    "MeshStageRuntime",
+    "get_mesh_stage_programs",
+]

@@ -103,6 +103,16 @@ _TRANSFER_STATS = {"copies": 0, "elided": 0}
 # XLA compiles for fwd/bwd/update.
 _PROGRAM_CACHE_STATS = {"hits": 0, "misses": 0}
 
+# Host-dispatch accounting: how many times per step the Python issue
+# loops call INTO jax — one count per jitted-program invocation
+# ("programs": fwd/bwd/accumulate/update/loss/rng-fold) and one per
+# device_put call that actually moves buffers ("puts"; elided puts are
+# already tracked in _TRANSFER_STATS).  This is the figure the mesh-
+# native engine collapses: a per-device loop pays O(devices) of these
+# per microbatch tick, a mesh-native drive O(stages).  Snapshot-and-diff
+# per step like the transfer counters.
+_DISPATCH_STATS = {"programs": 0, "puts": 0}
+
 # XLA backend-compile counter, fed by jax.monitoring: every executable the
 # backend actually compiles (a jit cache miss that wasn't served by the
 # persistent compilation cache) emits one duration event.  This is the
@@ -150,8 +160,33 @@ def hotpath_counters() -> Dict[str, int]:
         "transfers_elided": _TRANSFER_STATS["elided"],
         "program_cache_hits": _PROGRAM_CACHE_STATS["hits"],
         "program_cache_misses": _PROGRAM_CACHE_STATS["misses"],
+        "program_dispatches": _DISPATCH_STATS["programs"],
+        "put_dispatches": _DISPATCH_STATS["puts"],
         "xla_compiles": xla_compile_count(),
     }
+
+
+def _is_resident(x, target) -> bool:
+    """Is ``x`` already committed to ``target`` (a Device or Sharding)?
+
+    MPMD stages commit to concrete devices; mesh-native stages commit to
+    a ``NamedSharding`` over their sub-mesh — residency there is sharding
+    equality (same mesh devices, same spec), which is exactly the
+    condition under which a put would be a no-op copy.
+    """
+    if not isinstance(x, jax.Array):
+        return False
+    if isinstance(target, jax.sharding.Sharding):
+        if x.sharding == target:
+            return True
+        try:
+            # program outputs carry rank-normalized specs (P('dp') vs
+            # P('dp', None, ...)); equivalence, not equality, decides
+            # whether a put would move bytes
+            return x.sharding.is_equivalent_to(target, x.ndim)
+        except Exception:
+            return False
+    return x.device is target
 
 
 def device_put_elided(tree, device):
@@ -163,13 +198,16 @@ def device_put_elided(tree, device):
     overhead — the buffer is already where it must be.  Eliding it also
     preserves buffer identity, which is what lets backward donation reuse
     the producer's allocation instead of copying first.
+
+    ``device`` may be a concrete jax Device (MPMD stages) or a
+    ``jax.sharding.Sharding`` (mesh-native stages hand off activations
+    with a put-to-sharding); either way a moving put is ONE batched call.
     """
     if not HOTPATH:
+        _DISPATCH_STATS["puts"] += 1
         return jax.device_put(tree, device)
     leaves, treedef = jax.tree_util.tree_flatten(tree)
-    resident = [
-        isinstance(x, jax.Array) and x.device is device for x in leaves
-    ]
+    resident = [_is_resident(x, device) for x in leaves]
     if all(resident):
         # the steady-state fast path: no api call, no tree rebuild
         _TRANSFER_STATS["elided"] += len(leaves)
@@ -185,6 +223,7 @@ def device_put_elided(tree, device):
     # overhead in jax.device_put dwarfs the per-leaf cost, so per-leaf
     # puts would give back most of what elision saves
     moved = iter(jax.device_put(to_move, device))
+    _DISPATCH_STATS["puts"] += 1
     _TRANSFER_STATS["copies"] += len(to_move)
     _TRANSFER_STATS["elided"] += len(leaves) - len(to_move)
     tracer = get_tracer()
@@ -210,6 +249,7 @@ _fold1 = jax.jit(jax.random.fold_in)
 def _step_rngs(rng, M: int, S: int):
     """The per-(microbatch, stage) dropout-key table for one step."""
     if HOTPATH:
+        _DISPATCH_STATS["programs"] += M * S
         return [[_fold2(rng, m, k) for k in range(S)] for m in range(M)]
     return [
         [jax.random.fold_in(jax.random.fold_in(rng, m), k) for k in range(S)]
@@ -315,6 +355,12 @@ class _StagePrograms:
             updates, new_opt_state = optimizer.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), new_opt_state
 
+        # raw closures retained for subclasses (the mesh engine fuses
+        # accumulation AROUND these exact functions, so the two engines'
+        # stage math has one definition and cannot drift)
+        self._raw_fwd = fwd
+        self._raw_bwd = bwd
+        self._raw_bwd_params_only = bwd_params_only
         self.fwd = jax.jit(fwd)
         self.bwd = jax.jit(bwd)
         self.bwd_params_only = jax.jit(bwd_params_only)
@@ -345,6 +391,21 @@ class _StagePrograms:
             self.grad_add_donated = self.grad_add
 
 
+def cached_programs(key, factory):
+    """Bounded-LRU lookup in the process-global program cache: one
+    eviction/hit-count discipline shared by every program family (MPMD
+    stage programs here, the mesh twins in mesh_pipeline.py)."""
+    if key in _PROGRAM_CACHE:
+        _PROGRAM_CACHE_STATS["hits"] += 1
+        _PROGRAM_CACHE[key] = _PROGRAM_CACHE.pop(key)  # refresh LRU order
+    else:
+        _PROGRAM_CACHE_STATS["misses"] += 1
+        while len(_PROGRAM_CACHE) >= PROGRAM_CACHE_MAX_ENTRIES:
+            _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
+        _PROGRAM_CACHE[key] = factory()
+    return _PROGRAM_CACHE[key]
+
+
 def get_stage_programs(layer_cfgs, optimizer) -> _StagePrograms:
     import json
 
@@ -356,19 +417,22 @@ def get_stage_programs(layer_cfgs, optimizer) -> _StagePrograms:
         # programs (or vice versa)
         _donation_enabled(),
     )
-    if key in _PROGRAM_CACHE:
-        _PROGRAM_CACHE_STATS["hits"] += 1
-        _PROGRAM_CACHE[key] = _PROGRAM_CACHE.pop(key)  # refresh LRU order
-    else:
-        _PROGRAM_CACHE_STATS["misses"] += 1
-        while len(_PROGRAM_CACHE) >= PROGRAM_CACHE_MAX_ENTRIES:
-            _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
-        _PROGRAM_CACHE[key] = _StagePrograms(layer_cfgs, optimizer)
-    return _PROGRAM_CACHE[key]
+    return cached_programs(
+        key, lambda: _StagePrograms(layer_cfgs, optimizer)
+    )
 
 
 class StageRuntime:
     """One pipeline stage: layer slice + device + compiled programs."""
+
+    #: injectable slowdown-emulation hooks: the emulation measures the
+    #: program's blocked time with ``_clock`` and requests ``elapsed x
+    #: (slowdown - 1)`` from ``_sleep``.  Tests substitute deterministic
+    #: fakes so the emulated inflation is asserted exactly under any
+    #: host load (the wall-clock A/B form of the assertion flaked in
+    #: loaded full-suite runs).
+    _clock = staticmethod(time.perf_counter)
+    _sleep = staticmethod(time.sleep)
 
     def __init__(
         self,
@@ -412,6 +476,15 @@ class StageRuntime:
         self.opt_state = jax.device_put(optimizer.init(self.params), device)
 
     # --- execution ----------------------------------------------------------
+    def _emulate_slowdown(self, ref) -> None:
+        """Heterogeneity emulation: block on ``ref`` and sleep
+        ``elapsed x (slowdown - 1)``, through the injectable hooks."""
+        if self.slowdown > 1.0:
+            start = self._clock()
+            jax.block_until_ready(ref)
+            elapsed = self._clock() - start
+            self._sleep(elapsed * (self.slowdown - 1.0))
+
     def forward(self, inputs: Tuple, rng) -> Tuple:
         inputs = device_put_elided(inputs, self.device)
         return self.forward_placed(inputs, rng)
@@ -421,12 +494,9 @@ class StageRuntime:
         device — the issue loops place inputs themselves (they also store
         them for backward), so the placement pass here would be a no-op
         tree traversal per microbatch per stage."""
+        _DISPATCH_STATS["programs"] += 1
         out = self._fwd(self.params, inputs, rng)
-        if self.slowdown > 1.0:
-            start = time.perf_counter()
-            jax.block_until_ready(out)
-            elapsed = time.perf_counter() - start
-            time.sleep(elapsed * (self.slowdown - 1.0))
+        self._emulate_slowdown(out)
         return out
 
     def backward(self, inputs: Tuple, rng, dy: Tuple):
@@ -435,6 +505,7 @@ class StageRuntime:
         out); profiling paths that re-execute with the same buffers must
         use the undonated ``_bwd``/``_bwd_params_only`` directly."""
         dy = device_put_elided(dy, self.device)
+        _DISPATCH_STATS["programs"] += 1
         if self._differentiable_inputs:
             grads, dx = self._bwd_donated(self.params, inputs, rng, dy)
         else:
@@ -442,11 +513,7 @@ class StageRuntime:
                 self.params, inputs, rng, dy
             )
             dx = None
-        if self.slowdown > 1.0:
-            start = time.perf_counter()
-            jax.block_until_ready(grads)
-            elapsed = time.perf_counter() - start
-            time.sleep(elapsed * (self.slowdown - 1.0))
+        self._emulate_slowdown(grads)
         return grads, dx
 
     def accumulate(self, total, grads):
@@ -454,9 +521,21 @@ class StageRuntime:
             return grads
         # the old total dies here (the caller rebinds to the sum), so the
         # donating twin lets XLA accumulate into its buffer in place
+        _DISPATCH_STATS["programs"] += 1
         return self._grad_add_donated(total, grads)
 
+    def backward_accumulate(self, total, inputs: Tuple, rng, dy: Tuple):
+        """The fused issue point the schedules drive: one microbatch's
+        backward plus accumulation into the running per-stage total,
+        returning ``(new_total, dx)``.  The MPMD runtime issues two
+        programs (bwd, then grad_add); the mesh-native runtime overrides
+        this with ONE fused program — the gpipe/1f1b issue loops neither
+        know nor care which engine they are driving."""
+        grads, dx = self.backward(inputs, rng, dy)
+        return self.accumulate(total, grads), dx
+
     def apply_gradients(self, grads) -> None:
+        _DISPATCH_STATS["programs"] += 1
         self.params, self.opt_state = self._update(
             self.params, self.opt_state, grads
         )
@@ -504,6 +583,11 @@ class PipelineStats:
     transfers: int = 0
     transfers_elided: int = 0
     compiles: int = 0
+    # host dispatches this step (see _DISPATCH_STATS): jitted-program
+    # invocations and moving device_put calls — the count the mesh-native
+    # engine collapses from O(devices) to O(stages) per microbatch tick
+    program_dispatches: int = 0
+    put_dispatches: int = 0
 
     #: metric classification (telemetry.MetricsRegistry contract): the
     #: model rebinds ``stats`` to a FRESH object every step, so every
@@ -513,6 +597,7 @@ class PipelineStats:
         "loss": "gauge", "interleaved": "gauge", "dispatch_s": "gauge",
         "compute_wait_s": "gauge", "transfers": "gauge",
         "transfers_elided": "gauge", "compiles": "gauge",
+        "program_dispatches": "gauge", "put_dispatches": "gauge",
     }
 
     def snapshot(self) -> Dict[str, Any]:
@@ -703,6 +788,8 @@ class PipelineModel:
         compiles0 = xla_compile_count()
         copies0 = _TRANSFER_STATS["copies"]
         elided0 = _TRANSFER_STATS["elided"]
+        programs0 = _DISPATCH_STATS["programs"]
+        puts0 = _DISPATCH_STATS["puts"]
         grad_totals, losses, (t0, t1, t2) = self.compute_gradients(
             data, labels, rng
         )
@@ -721,7 +808,20 @@ class PipelineModel:
             transfers=_TRANSFER_STATS["copies"] - copies0,
             transfers_elided=_TRANSFER_STATS["elided"] - elided0,
             compiles=xla_compile_count() - compiles0,
+            program_dispatches=_DISPATCH_STATS["programs"] - programs0,
+            put_dispatches=_DISPATCH_STATS["puts"] - puts0,
         )
+        tracer = get_tracer()
+        if tracer is not None:
+            # one host-dispatch span per step on its own lane, so
+            # trace_report can attribute the step's dispatch share the
+            # same way PipelineStats does (the span's duration IS
+            # dispatch_s, placed ending now)
+            end = tracer.now()
+            tracer.complete(
+                "host_dispatch", tracer.lane("host", "dispatch"),
+                max(end - dispatch_s * 1e6, 0.0), dur_us=dispatch_s * 1e6,
+            )
         return total_loss
 
     def _trace_lanes(self):
@@ -743,6 +843,21 @@ class PipelineModel:
         """True when gradients come from the fused-fwd/bwd 1F1B path (the
         single source for both schedule dispatch and stats labeling)."""
         return self.schedule == "1f1b" and self.num_microbatches > 1
+
+    def _step_rngs(self, rng, M: int, S: int):
+        """The per-(microbatch, stage) rng table the issue loops index.
+
+        Engine hook: the MPMD runtime pre-folds keys host-side (one
+        jitted pair-fold per cell); the mesh-native runtime overrides
+        this with zero-dispatch ``(base, m, k)`` triples folded INSIDE
+        each stage program (identical threefry math either way).
+        """
+        return _step_rngs(rng, M, S)
+
+    def _loss_dispatch(self, logits, labels, scale):
+        """One counted invocation of the compiled loss+dlogits program."""
+        _DISPATCH_STATS["programs"] += 1
+        return self._loss_and_dlogits(logits, labels, scale)
 
     def compute_gradients(
         self,
@@ -806,7 +921,7 @@ class PipelineModel:
         # ---- forward (fill): per microbatch, per stage; keep stage inputs
         stage_inputs: List[List[Tuple]] = [[] for _ in self.stages]
         final_acts_per_mb: List[Tuple] = []
-        rngs = _step_rngs(rng, M, len(self.stages))
+        rngs = self._step_rngs(rng, M, len(self.stages))
         for m in range(M):
             acts = micro_data[m]
             for k, stage in enumerate(self.stages):
@@ -830,7 +945,7 @@ class PipelineModel:
         for m in reversed(range(M)):
             labels_m = device_put_elided(micro_labels[m], self._last_device)
             final_acts = final_acts_per_mb[m]
-            loss_m, dlogits = self._loss_and_dlogits(
+            loss_m, dlogits = self._loss_dispatch(
                 final_acts[0], labels_m, scale
             )
             losses.append(loss_m)
@@ -838,16 +953,15 @@ class PipelineModel:
             for k in reversed(range(len(self.stages))):
                 stage = self.stages[k]
                 if tracer is None:
-                    grads, dx = stage.backward(
-                        stage_inputs[k][m], rngs[m][k], dy
+                    grad_totals[k], dx = stage.backward_accumulate(
+                        grad_totals[k], stage_inputs[k][m], rngs[m][k], dy
                     )
                 else:
                     span0 = tracer.now()
-                    grads, dx = stage.backward(
-                        stage_inputs[k][m], rngs[m][k], dy
+                    grad_totals[k], dx = stage.backward_accumulate(
+                        grad_totals[k], stage_inputs[k][m], rngs[m][k], dy
                     )
                     tracer.complete("bwd", lanes[k], span0, {"mb": m})
-                grad_totals[k] = stage.accumulate(grad_totals[k], grads)
                 dy = dx
         dispatch_s += time.perf_counter() - t1
         self._last_dispatch_s = dispatch_s
@@ -887,7 +1001,7 @@ class PipelineModel:
         scale = 1.0 / M
         tracer, lanes = self._trace_lanes()
 
-        rngs = _step_rngs(rng, M, S)
+        rngs = self._step_rngs(rng, M, S)
 
         t0 = time.perf_counter()
         # prefetch (see the GPipe path): inputs to stage 0, labels to the
@@ -944,7 +1058,7 @@ class PipelineModel:
                 labels_m = device_put_elided(
                     micro_labels[m], self._last_device
                 )
-                loss_m, dlogits = self._loss_and_dlogits(
+                loss_m, dlogits = self._loss_dispatch(
                     out[0], labels_m, scale
                 )
                 losses.append(loss_m)
@@ -956,16 +1070,15 @@ class PipelineModel:
             stage = self.stages[k]
             dy = dys[k].pop(m) if k == S - 1 else dys[k + 1].pop(m)
             if tracer is None:
-                grads, dx = stage.backward(
-                    stage_inputs[k].pop(m), rngs[m][k], dy
+                grad_totals[k], dx = stage.backward_accumulate(
+                    grad_totals[k], stage_inputs[k].pop(m), rngs[m][k], dy
                 )
             else:
                 span0 = tracer.now()
-                grads, dx = stage.backward(
-                    stage_inputs[k].pop(m), rngs[m][k], dy
+                grad_totals[k], dx = stage.backward_accumulate(
+                    grad_totals[k], stage_inputs[k].pop(m), rngs[m][k], dy
                 )
                 tracer.complete("bwd", lanes[k], span0, {"mb": m})
-            grad_totals[k] = stage.accumulate(grad_totals[k], grads)
             if k > 0:
                 dys[k][m] = dx
             bwd_next[k] += 1

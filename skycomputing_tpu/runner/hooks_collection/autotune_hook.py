@@ -310,18 +310,64 @@ class AutotuneHook(Hook):
             wm = runner.worker_manager
             partition = snapshot_partition(wm)
             calibration = allocator.snapshot_calibration()
+            # a mesh-native model re-solves the MESH SHAPE (layer slices
+            # AND chips-per-stage) instead of the heterogeneous-device
+            # partition: a straggler stage sheds layers or gains chips,
+            # actuated through the same verify-then-apply rebuild path
+            mesh_native = (
+                hasattr(model, "chips_per_stage")
+                and hasattr(allocator, "refine_mesh_allocation")
+            )
 
             def undo():
                 restore_partition(wm, partition)
                 allocator.restore_calibration(calibration)
 
             try:
-                allocator.refine_allocation(
-                    list(proposal.value),
-                    damping=self._damping,
-                    max_time=self._solver_time_s,
-                    attribute="devices",
-                )
+                if mesh_native:
+                    from ...analysis.plan_check import (
+                        PlanIssue,
+                        verify_mesh_payload,
+                    )
+
+                    allocator.refine_mesh_allocation(
+                        list(proposal.value), damping=self._damping,
+                        # the ENGINE's live chips, not the pool's: a
+                        # model built with an explicit chips_per_stage
+                        # argument has no mesh_chips on its workers and
+                        # the default-1 fallback would de-scale wide
+                        # stages wrong
+                        chips=list(model.chips_per_stage),
+                    )
+                    payload = {
+                        "chips_per_stage": [
+                            int(w.extra_config.get("mesh_chips", 1))
+                            for w in wm.worker_pool if w.model_config
+                        ],
+                        "num_devices": len(model._devices),
+                        "tp": getattr(model, "_tp", 1),
+                    }
+                    if runner.current_batch is not None:
+                        data = runner.current_batch[0]
+                        leaf = (data[0] if isinstance(data, (tuple, list))
+                                else data)
+                        payload["microbatch_rows"] = max(
+                            int(leaf.shape[0])
+                            // max(model.num_microbatches, 1), 1,
+                        )
+                    problems = verify_mesh_payload(payload)
+                    if problems:
+                        raise PlanError([
+                            PlanIssue("mesh", "error", p)
+                            for p in problems
+                        ])
+                else:
+                    allocator.refine_allocation(
+                        list(proposal.value),
+                        damping=self._damping,
+                        max_time=self._solver_time_s,
+                        attribute="devices",
+                    )
                 if runner.current_batch is not None:
                     verify_plan(
                         allocator.model_config, wm,

@@ -74,6 +74,7 @@ class SelfHealHook(Hook):
         mode: str = "inprocess",
         snapshot_path: Optional[str] = None,
         rendezvous_dir: Optional[str] = None,
+        clock=None,
     ):
         if mode not in ("inprocess", "exit"):
             raise ValueError(f"unknown mode {mode!r}")
@@ -103,6 +104,12 @@ class SelfHealHook(Hook):
         self._mode = mode
         self._snapshot_path = snapshot_path
         self._rendezvous_dir = rendezvous_dir
+        # injectable iteration clock: detection compares wall times, so
+        # under heavy host load the EWMA trigger can read every iteration
+        # as slow (or the baseline as already-degraded).  Tests inject a
+        # deterministic clock tied to the emulated fault instead of
+        # racing the real one (the observed tier-1 flake).
+        self._clock = clock if clock is not None else time.perf_counter
 
         self.heals = 0
         self.events: List[Dict[str, Any]] = []
@@ -124,12 +131,12 @@ class SelfHealHook(Hook):
         self._started: Optional[float] = None
 
     def before_iter(self, runner):
-        self._started = time.perf_counter()
+        self._started = self._clock()
 
     def after_iter(self, runner):
         if self._disarmed or self._started is None:
             return
-        elapsed = time.perf_counter() - self._started
+        elapsed = self._clock() - self._started
         self._started = None
         self._seen_iters += 1
         if self._seen_iters <= self._grace_iters:

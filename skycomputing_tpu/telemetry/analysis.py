@@ -300,6 +300,25 @@ def analyze(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         report["serving"]["padding_fraction"] = (
             round(padding, 4) if padding is not None else None
         )
+    # host-dispatch share: one "host_dispatch" span per train step (its
+    # duration IS the engine's PipelineStats.dispatch_s), so the trace
+    # carries the same dispatch fraction the engine reports — the figure
+    # the mesh-native drive collapses
+    dispatch = _clip(
+        [
+            (float(ev["ts"]), float(ev["ts"]) + float(ev.get("dur", 0)))
+            for ev in events
+            if ev.get("ph") == "X" and ev.get("name") == "host_dispatch"
+        ],
+        *window,
+    )
+    if dispatch:
+        dispatch_us = busy_us(dispatch)
+        report["dispatch"] = {
+            "total_ms": round(dispatch_us / 1e3, 3),
+            "share": round(dispatch_us / window_us, 4),
+            "steps": len(dispatch),
+        }
     compiles = named_durations(events, "xla_compile")
     report["xla_compiles"] = {
         "count": len(compiles),

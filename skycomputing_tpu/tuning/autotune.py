@@ -47,19 +47,26 @@ def window_events(tracer, t0_us: float) -> List[Dict[str, Any]]:
 
 
 def snapshot_partition(worker_manager) -> List[tuple]:
-    """Per-worker (id, layer slice, order) — everything
-    :func:`restore_partition` needs to undo a re-allocation."""
+    """Per-worker (id, layer slice, order, mesh chips) — everything
+    :func:`restore_partition` needs to undo a re-allocation, including a
+    mesh reshape (``mesh_chips`` is the sub-mesh width
+    ``Allocator.mesh_allocate`` wrote, None for MPMD partitions)."""
     return [
-        (w.id, list(w.model_config or []), w.order)
+        (w.id, list(w.model_config or []), w.order,
+         w.extra_config.get("mesh_chips"))
         for w in worker_manager.worker_pool
     ]
 
 
 def restore_partition(worker_manager, snapshot: List[tuple]) -> None:
-    for worker_id, model_config, order in snapshot:
+    for worker_id, model_config, order, mesh_chips in snapshot:
         worker = worker_manager.get_by_id(worker_id)
         worker.model_config = model_config
         worker.order = order
+        if mesh_chips is None:
+            worker.extra_config.pop("mesh_chips", None)
+        else:
+            worker.extra_config["mesh_chips"] = mesh_chips
     worker_manager.reset_rank_by_order()
 
 

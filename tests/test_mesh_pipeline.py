@@ -1,0 +1,328 @@
+"""Mesh-native stage execution: per-stage NamedSharding programs.
+
+Contracts: bitwise gradient/param equivalence with the MPMD engine on
+the same allocation (both schedules), forced-8-device sub-mesh
+placement (contiguous blocks, dp sharding inside a stage), the
+dispatch-per-tick collapse the hotpath counters measure, the allocator
+mesh-shape search + closed-loop refine, the plan_check mesh schema, and
+the straggler -> mesh-reshape actuation through AutotuneHook's
+verify-then-apply path.
+"""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from skycomputing_tpu.analysis.plan_check import verify_mesh_payload
+from skycomputing_tpu.dynamics import (
+    Allocator,
+    ParameterServer,
+    WorkerManager,
+    solve_mesh_shapes,
+)
+from skycomputing_tpu.models import bert_config, bert_layer_configs
+from skycomputing_tpu.ops import cross_entropy_loss
+from skycomputing_tpu.parallel import MeshPipelineModel, PipelineModel
+from skycomputing_tpu.parallel.pipeline import hotpath_counters
+
+# one optimizer for the module: stage programs cache on
+# (layer configs, id(optimizer)), so the suite's worlds share compiles
+_OPT = optax.sgd(1e-2)
+
+
+def _world(devices, n_workers, units=2, batch=8, seq=16, seed=0,
+           mesh_chips=None):
+    cfg = bert_config("tiny", dtype="float32", hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)
+    mc = bert_layer_configs(cfg, num_encoder_units=units, num_classes=3,
+                            deterministic=True)
+    wm = WorkerManager()
+    wm.load_worker_pool_from_config(
+        [dict(name=f"n{i}", device_config=dict(device_index=i),
+              extra_config=(
+                  dict(mesh_chips=mesh_chips[i])
+                  if mesh_chips is not None else {}
+              ))
+         for i in range(n_workers)]
+    )
+    Allocator(mc, wm, None, None).even_allocate()
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(5, 1024, size=(batch, seq)).astype(np.int32)
+    data = (ids, np.zeros_like(ids), np.ones_like(ids))
+    labels = rng.integers(0, 3, size=(batch,)).astype(np.int32)
+    ps = ParameterServer(mc, example_inputs=data, rng=jax.random.key(seed))
+    return wm, ps, mc, data, labels
+
+
+def _params_bitwise_equal(a, b):
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for s1, s2 in zip(a.stages, b.stages)
+        for x, y in zip(jax.tree_util.tree_leaves(s1.params),
+                        jax.tree_util.tree_leaves(s2.params))
+    )
+
+
+def test_mesh_matches_mpmd_params_bitwise(devices):
+    """On the same allocation (one chip per stage) the mesh-native
+    engine and the MPMD engine produce bitwise-identical losses and
+    params, steps under gpipe THEN 1f1b (cumulative)."""
+    wm1, ps1, _, data, labels = _world(devices, n_workers=3)
+    wm2, ps2, *_ = _world(devices, n_workers=3)
+    mpmd = PipelineModel(wm1, ps1, _OPT, cross_entropy_loss,
+                         devices=devices, num_microbatches=4)
+    mesh = MeshPipelineModel(wm2, ps2, _OPT, cross_entropy_loss,
+                             devices=devices, num_microbatches=4)
+    for schedule, keys in (("gpipe", (0, 1)), ("1f1b", (2, 3))):
+        mpmd.schedule = mesh.schedule = schedule
+        for i in keys:
+            key = jax.random.key(i)
+            l1 = mpmd.train_step(data, labels, rng=key)
+            l2 = mesh.train_step(data, labels, rng=key)
+            assert l1 == l2, (schedule, i, l1, l2)
+        assert _params_bitwise_equal(mpmd, mesh), schedule
+
+
+def test_mesh_submesh_placement_8_devices(devices):
+    """4 stages x 2 chips on the forced 8-device host: each stage's
+    params live replicated on its CONTIGUOUS device block, activations
+    shard over the stage's dp axis, and a step trains."""
+    wm, ps, _, data, labels = _world(
+        devices, n_workers=4, units=3, mesh_chips=[2, 2, 2, 2]
+    )
+    model = MeshPipelineModel(wm, ps, _OPT, cross_entropy_loss,
+                              devices=devices, num_microbatches=2)
+    assert model.chips_per_stage == [2, 2, 2, 2]
+    for i, stage in enumerate(model.stages):
+        block = set(devices[2 * i:2 * i + 2])
+        assert set(stage.mesh.devices.flatten()) == block
+        assert stage.dp == 2 and stage.tp == 1
+        for leaf in jax.tree_util.tree_leaves(stage.params):
+            assert leaf.devices() == block  # replicated over the block
+    # activations shard their batch rows over the stage's dp axis
+    acts = model.stages[0].forward(
+        jax.tree_util.tree_map(lambda x: x[:4], data), None
+    )
+    shards = acts[0].addressable_shards
+    assert {s.device for s in shards} == set(devices[0:2])
+    assert all(s.data.shape[0] == 2 for s in shards)  # 4 rows / dp=2
+    loss = model.train_step(data, labels, rng=jax.random.key(0))
+    assert np.isfinite(loss)
+
+
+# slow: the suite's heaviest world pair (8-stage MPMD + 4-stage mesh,
+# ~12 s of compiles); the same >=2x collapse is gated on every bench
+# regeneration via BENCH_mesh_pipeline.json, so tier-1 keeps only the
+# cheaper counter pins below
+@pytest.mark.perf
+@pytest.mark.slow
+def test_mesh_collapses_dispatches_per_tick(devices):
+    """At the same device budget, the mesh drive issues >=2x fewer host
+    dispatches per microbatch tick than the per-device loop (the
+    BENCH_mesh_pipeline.json gate)."""
+    M = 4
+    wm1, ps1, _, data, labels = _world(devices, n_workers=8, units=3)
+    per_device = PipelineModel(wm1, ps1, _OPT, cross_entropy_loss,
+                               devices=devices, num_microbatches=M)
+    wm2, ps2, *_ = _world(devices, n_workers=4, units=3)
+    mesh = MeshPipelineModel(wm2, ps2, _OPT, cross_entropy_loss,
+                             devices=devices, num_microbatches=M)
+
+    def per_tick(model):
+        model.train_step(data, labels, rng=jax.random.key(0))  # warm
+        c0 = hotpath_counters()
+        model.train_step(data, labels, rng=jax.random.key(1))
+        c1 = hotpath_counters()
+        return (
+            (c1["program_dispatches"] - c0["program_dispatches"])
+            + (c1["put_dispatches"] - c0["put_dispatches"])
+        ) / M
+
+    base_tick = per_tick(per_device)
+    mesh_tick = per_tick(mesh)
+    assert mesh_tick * 2 <= base_tick, (base_tick, mesh_tick)
+    # per-step stats carry the same counters
+    assert mesh.stats.program_dispatches > 0
+    assert per_device.stats.program_dispatches > \
+        mesh.stats.program_dispatches
+
+
+def test_mesh_rejects_indivisible_microbatch(devices):
+    """A microbatch whose rows don't divide a stage's dp fails with a
+    named diagnostic before any dispatch."""
+    wm, ps, _, data, labels = _world(
+        devices, n_workers=2, batch=6, mesh_chips=[2, 2]
+    )
+    model = MeshPipelineModel(wm, ps, _OPT, cross_entropy_loss,
+                              devices=devices, num_microbatches=2)
+    with pytest.raises(ValueError, match="dp=2"):
+        model.compute_gradients(data, labels)
+
+
+def test_solve_mesh_shapes_contract():
+    """Chips balance per-stage time/chip, respect caps and memory, and
+    the stage_overhead term trades stages for issue-loop length."""
+    r = solve_mesh_shapes([1.0] * 12, 8, max_chips_per_stage=2)
+    assert r.slices == [(0, 3), (3, 6), (6, 9), (9, 12)]
+    assert r.chips == [2, 2, 2, 2] and r.bottleneck == pytest.approx(1.5)
+    # the costliest stage earns the most chips
+    r = solve_mesh_shapes([6.0, 1.0, 1.0, 1.0, 1.0], 8, max_stages=5)
+    heavy = max(range(r.num_stages), key=lambda i: r.stage_costs[i])
+    assert r.chips[heavy] == max(r.chips)
+    assert sum(r.chips) <= 8
+    # dispatch tax -> fewer stages; no tax + no cap -> one stage
+    free = solve_mesh_shapes([1.0] * 12, 8, max_chips_per_stage=1)
+    taxed = solve_mesh_shapes([1.0] * 12, 8, max_chips_per_stage=1,
+                              stage_overhead=1.0)
+    assert taxed.num_stages < free.num_stages
+    assert solve_mesh_shapes([1.0] * 12, 8).num_stages == 1
+    # params replicate over the sub-mesh: a slice must fit ONE chip
+    with pytest.raises(RuntimeError, match="infeasible"):
+        solve_mesh_shapes([1.0] * 4, 2, layer_mem=[10.0] * 4,
+                          mem_per_chip=15.0)
+
+
+def test_mesh_allocate_writes_chips_and_refines():
+    """mesh_allocate lands slices + mesh_chips on the pool;
+    refine_mesh_allocation folds measured stage times (de-scaled by
+    chips) into the layer costs and re-solves — a slow stage sheds
+    layers, PipeDream-style."""
+    n_layers = 12
+    wm = WorkerManager()
+    wm.load_worker_pool_from_config(
+        [dict(name=f"n{i}", device_config=dict(device_index=i))
+         for i in range(8)]
+    )
+
+    class _Dev:
+        def benchmark(self):
+            return {f"worker{w.rank}": dict(time=1.0, avai_mem=1e6)
+                    for w in wm.worker_pool}
+
+    class _Mod:
+        def benchmark(self):
+            return [1.0] * n_layers, [0.1] * n_layers
+
+    mc = [dict(layer_type="Linear_Proxy", idx=i) for i in range(n_layers)]
+    alloc = Allocator(mc, wm, _Mod(), _Dev())
+    alloc.mesh_allocate(max_chips_per_stage=2)
+    staged = sorted((w for w in wm.worker_pool if w.model_config),
+                    key=lambda w: w.order)
+    chips = [w.extra_config["mesh_chips"] for w in staged]
+    assert chips == [2, 2, 2, 2]
+    assert [len(w.model_config) for w in staged] == [3, 3, 3, 3]
+    assert all("mesh_chips" not in w.extra_config
+               for w in wm.worker_pool if not w.model_config)
+    # stage 0 measures 3x slower than its cost model predicts -> its
+    # layers get costlier and the re-solve sheds layers from it
+    alloc.refine_mesh_allocation([3.0, 1.0, 1.0, 1.0], damping=1.0)
+    staged = sorted((w for w in wm.worker_pool if w.model_config),
+                    key=lambda w: w.order)
+    assert len(staged[0].model_config) < 3
+    assert sum(len(w.model_config) for w in staged) == n_layers
+    assert sum(w.extra_config["mesh_chips"] for w in staged) <= 8
+
+
+@pytest.mark.lint
+def test_verify_mesh_payload_contract():
+    ok = {"chips_per_stage": [2, 2, 1], "num_devices": 8, "tp": 1}
+    assert verify_mesh_payload(ok) == []
+    assert verify_mesh_payload("nope")  # not an object
+    assert any("non-empty" in p for p in verify_mesh_payload(
+        {"chips_per_stage": [], "num_devices": 4}))
+    assert any("positive int" in p for p in verify_mesh_payload(
+        {"chips_per_stage": [2, 0], "num_devices": 4}))
+    assert any("must fit" in p for p in verify_mesh_payload(
+        {"chips_per_stage": [4, 4], "num_devices": 4}))
+    assert any("tp=2" in p for p in verify_mesh_payload(
+        {"chips_per_stage": [2, 3], "num_devices": 8, "tp": 2}))
+    # dp must divide the live microbatch rows, or the engine rejects the
+    # first step AFTER the plan committed — the schema catches it first
+    assert verify_mesh_payload(
+        {"chips_per_stage": [2, 2], "num_devices": 8,
+         "microbatch_rows": 4}) == []
+    assert any("does not divide" in p for p in verify_mesh_payload(
+        {"chips_per_stage": [4, 2], "num_devices": 8,
+         "microbatch_rows": 6}))
+    assert any("positive int" in p for p in verify_mesh_payload(
+        {"chips_per_stage": [2], "num_devices": 8,
+         "microbatch_rows": 0}))
+    # rides the re-form payload schema
+    from skycomputing_tpu.analysis.plan_check import (
+        verify_allocation_payload,
+    )
+    bad = {"device_scale": {"0": 1.0},
+           "mesh": {"chips_per_stage": [9], "num_devices": 4}}
+    assert any("must fit" in p for p in verify_allocation_payload(bad))
+
+
+@pytest.mark.tune
+def test_autotune_straggler_actuates_mesh_reshape(devices, monkeypatch):
+    """A straggler proposal on a mesh-native model re-solves the MESH
+    SHAPE through verify-then-apply: the reshape passes the plan + mesh
+    schema checks, applies via rebuild(), and the committed world keeps
+    training; the worker pool carries the new chips."""
+    import skycomputing_tpu.runner.hooks_collection.autotune_hook as mod
+    from skycomputing_tpu.runner import AutotuneHook, Runner
+    from skycomputing_tpu.tuning import Proposal
+    from tests.test_tuning import _Loader, _ScriptedAdvisor
+
+    n_layers = 12
+    cfg = bert_config("tiny", dtype="float32", hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)
+    mc = bert_layer_configs(cfg, num_encoder_units=3, num_classes=3,
+                            deterministic=True)
+    assert len(mc) == n_layers
+    wm = WorkerManager()
+    wm.load_worker_pool_from_config(
+        [dict(name=f"n{i}", device_config=dict(device_index=i))
+         for i in range(8)]
+    )
+
+    class _Dev:
+        def benchmark(self):
+            return {f"worker{w.rank}": dict(time=1.0, avai_mem=1e6)
+                    for w in wm.worker_pool}
+
+    class _Mod:
+        def benchmark(self):
+            return [1.0] * n_layers, [0.1] * n_layers
+
+    alloc = Allocator(mc, wm, _Mod(), _Dev())
+    alloc.mesh_allocate(max_chips_per_stage=2)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(5, 1024, size=(8, 16)).astype(np.int32)
+    data = (ids, np.zeros_like(ids), np.ones_like(ids))
+    labels = rng.integers(0, 3, size=(8,)).astype(np.int32)
+    ps = ParameterServer(mc, example_inputs=data, rng=jax.random.key(0))
+    model = MeshPipelineModel(wm, ps, _OPT, cross_entropy_loss,
+                              devices=devices, num_microbatches=2)
+    assert model.chips_per_stage == [2, 2, 2, 2]
+    # stage 0 reads 3x slow -> the refine sheds its layers
+    straggle = Proposal(knob="allocation", value=[3.0, 1.0, 1.0, 1.0],
+                        signature="straggler", metric="step_p50_ms",
+                        reason="scripted")
+    monkeypatch.setattr(mod, "improved", lambda *a, **k: True)
+    hook = AutotuneHook(allocator=alloc,
+                        advisor=_ScriptedAdvisor(straggle), tune_every=2)
+    runner = Runner(model, ps, wm, max_epochs=1, max_iters=8)
+    runner.register_hook(hook)
+    runner.train(_Loader(data, labels, 8))
+
+    outcomes = [e["outcome"] for e in hook.events]
+    assert "applied" in outcomes and "committed" in outcomes
+    staged = sorted((w for w in wm.worker_pool if w.model_config),
+                    key=lambda w: w.order)
+    assert len(staged[0].model_config) < 3  # straggler stage shed layers
+    assert model.chips_per_stage == [
+        w.extra_config["mesh_chips"] for w in staged
+    ]
+    assert sum(model.chips_per_stage) <= len(devices)
+    assert model.partition_signature() == [
+        len(w.model_config) for w in staged
+    ]
+    # it still trains on the reshaped mesh
+    assert np.isfinite(
+        model.train_step(data, labels, rng=jax.random.key(9))
+    )

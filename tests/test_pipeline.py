@@ -176,19 +176,38 @@ def test_checkpoint_survives_reallocation(devices, tmp_path):
                                atol=2e-5)
 
 
-def test_slowdown_inflates_step_time(devices):
+def test_slowdown_inflates_step_time(devices, monkeypatch):
+    """Slowdown emulation inflates the step: every slowed program issue
+    requests ``elapsed x (factor - 1)`` of extra sleep, a fast stage
+    requests none.  Asserted through the injectable clock/sleep hooks so
+    the contract is exact under any host load — the wall-clock A/B form
+    of this test raced two timed steps and flaked in loaded full-suite
+    runs (CHANGES.md PR 11/12)."""
+    from skycomputing_tpu.parallel.pipeline import StageRuntime
+
+    fake_t = [0.0]
+
+    def clock():
+        fake_t[0] += 0.01  # every read advances one deterministic tick
+        return fake_t[0]
+
+    requested = []
+    monkeypatch.setattr(StageRuntime, "_clock", staticmethod(clock))
+    monkeypatch.setattr(StageRuntime, "_sleep",
+                        staticmethod(requested.append))
+
     fast, data, labels, _ = build_pipeline(devices, n_workers=2, units=1)
+    fast.train_step(data, labels, rng=jax.random.key(0))
+    assert requested == []  # slowdown 1.0 never sleeps
+
     slow, *_ = build_pipeline(devices, n_workers=2, units=1,
                               slowdowns=[8.0, 8.0])
-    fast.train_step(data, labels, rng=jax.random.key(0))  # warm compile
     slow.train_step(data, labels, rng=jax.random.key(0))
-    import time
-
-    t0 = time.perf_counter(); fast.train_step(data, labels, rng=jax.random.key(1))
-    t_fast = time.perf_counter() - t0
-    t0 = time.perf_counter(); slow.train_step(data, labels, rng=jax.random.key(1))
-    t_slow = time.perf_counter() - t0
-    assert t_slow > t_fast * 2, (t_fast, t_slow)
+    # one request per slowed program issue: 2 stages x (fwd + bwd)
+    assert len(requested) == 4, requested
+    # elapsed reads exactly one 0.01 tick, factor 8 -> 0.07 each
+    for sleep_s in requested:
+        assert sleep_s == pytest.approx(0.01 * 7.0)
 
 
 @pytest.mark.slow

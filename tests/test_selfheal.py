@@ -537,6 +537,26 @@ def test_atomic_save_survives_kill_during_write(devices, tmp_path,
     assert len(ps2.params) == len(ps._model_config)
 
 
+class _EmulatedIterClock:
+    """Deterministic iteration clock for SelfHealHook: every read
+    advances by a tick proportional to the pipeline's WORST emulated
+    slowdown, so detection follows the injected fault exactly instead of
+    racing real wall time — under full-suite load the real-clock EWMA
+    read every iteration as slow (or the baseline as degraded) and this
+    test flaked (CHANGES.md PR 11/12).  The confirm pass still runs the
+    real ``measure_stage_times`` + divergence math."""
+
+    def __init__(self, model, tick_s: float = 0.05):
+        self._model = model
+        self._tick_s = tick_s
+        self._now = 0.0
+
+    def __call__(self) -> float:
+        worst = max(s.slowdown for s in self._model.stages)
+        self._now += self._tick_s * worst
+        return self._now
+
+
 def test_selfheal_exit_mode_stages_payload_and_exits(devices, tmp_path):
     """Supervised path: instead of repartitioning in process, the hook
     snapshots, stages the measured device scales for the rendezvous, and
@@ -557,6 +577,7 @@ def test_selfheal_exit_mode_stages_payload_and_exits(devices, tmp_path):
         alloc, window=2, k_windows=2, threshold=1.35, grace_iters=1,
         measure_repeats=1, measure_inner=1, mode="exit",
         snapshot_path=snapshot, rendezvous_dir=str(rdv),
+        clock=_EmulatedIterClock(model),
     )
     runner = Runner(model, ps, wm, max_epochs=100, max_iters=40)
     runner.register_hook(FaultInjectionHook(

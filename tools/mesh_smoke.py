@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""CI smoke for the mesh-shape search + sub-mesh helpers.
+
+Loads ``dynamics/solver.py`` by file path (the skylint idiom — the
+solver is pure stdlib by contract, see the skyaudit MANIFEST) and
+drives :func:`solve_mesh_shapes` through its contract: chips sum to the
+device budget, heavier stages earn more chips, ``stage_overhead``
+steers toward shorter issue loops, ``max_chips_per_stage`` caps useful
+parallelism, and memory-infeasible shapes raise instead of silently
+under-covering.  This is the allocator half of mesh-native stage
+execution — the engine builds exactly the sub-mesh slices this search
+emits, so drift here is a misplaced fleet waiting to ship.
+
+The jax section (sub-mesh construction via
+``parallel.mesh.stage_submeshes``) self-SKIPs on bare runners with no
+jax installed, exit 0 — the lint job stays green while jax-equipped
+runners get the real check.
+
+Usage::
+
+    python tools/mesh_smoke.py
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+
+def _load_by_path(name: str, *parts: str):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ROOT, *parts)
+    )
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[name] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+try:
+    from skycomputing_tpu.dynamics import solver as _solver
+except Exception:  # pragma: no cover - exercised on bare CI runners
+    _solver = _load_by_path(
+        "_skytpu_mesh_smoke", "skycomputing_tpu", "dynamics", "solver.py"
+    )
+
+
+def check(cond, message):
+    if not cond:
+        print(f"FAIL: {message}")
+        raise SystemExit(1)
+    print(f"  ok: {message}")
+
+
+def main() -> int:
+    solve = _solver.solve_mesh_shapes
+
+    print("balanced shapes:")
+    r = solve([1.0] * 12, 8, max_chips_per_stage=2)
+    check(r.num_stages == 4 and r.chips == [2, 2, 2, 2],
+          "12 unit layers on 8 chips, dp<=2 -> 4 stages x 2 chips")
+    check(r.slices == [(0, 3), (3, 6), (6, 9), (9, 12)],
+          "slices are the balanced contiguous cover")
+    check(abs(r.bottleneck - 1.5) < 1e-9,
+          "bottleneck = slice cost / chips")
+    check(sum(r.chips) <= r.num_devices, "chips fit the device budget")
+
+    print("cost-weighted chips:")
+    r = solve([6.0, 1.0, 1.0, 1.0, 1.0], 8, max_stages=5)
+    heavy = max(range(r.num_stages), key=lambda i: r.stage_costs[i])
+    check(r.chips[heavy] == max(r.chips),
+          "the costliest stage holds the most chips")
+    check(sum(r.chips) <= 8, "never more chips than devices")
+
+    print("stage-overhead steering:")
+    free = solve([1.0] * 12, 8, max_chips_per_stage=1)
+    taxed = solve([1.0] * 12, 8, max_chips_per_stage=1,
+                  stage_overhead=1.0)
+    check(taxed.num_stages < free.num_stages,
+          "a per-stage dispatch tax buys fewer stages "
+          f"({free.num_stages} -> {taxed.num_stages})")
+
+    print("tie-breaks and caps:")
+    r = solve([1.0] * 12, 8)  # uncapped: one stage, all chips
+    check(r.num_stages == 1 and r.chips == [8],
+          "no dp cap -> ties break to the fewest stages")
+    r = solve([1.0] * 3, 8, max_chips_per_stage=2)
+    check(all(k <= 2 for k in r.chips) and sum(r.chips) <= 8,
+          "max_chips_per_stage caps every stage; surplus chips unspent")
+
+    print("feasibility:")
+    try:
+        solve([1.0] * 4, 2, layer_mem=[10.0] * 4, mem_per_chip=15.0)
+        check(False, "mem-infeasible shape must raise")
+    except RuntimeError as exc:
+        check("mesh-shape search infeasible" in str(exc),
+              "infeasible memory raises with a named diagnostic")
+    try:
+        solve([1.0], 0)
+        check(False, "zero devices must raise")
+    except ValueError:
+        check(True, "zero devices raises")
+    empty = solve([], 4)
+    check(empty.num_stages == 0, "zero layers -> empty shape")
+
+    print("jax sub-mesh construction:")
+    try:
+        import jax
+        from skycomputing_tpu.parallel.mesh import stage_submeshes
+    except Exception as exc:  # pragma: no cover - bare runner
+        print(f"  SKIP: jax unavailable ({type(exc).__name__}); "
+              f"sub-mesh construction checked in tests/test_mesh_pipeline.py")
+        print("mesh smoke: all checks passed (jax section skipped)")
+        return 0
+    devs = jax.devices()
+    meshes = stage_submeshes([1], devs[:1])
+    check(meshes[0].axis_names == ("dp", "tp"),
+          "sub-meshes carry the ('dp', 'tp') named axes")
+    check(meshes[0].devices.shape == (1, 1),
+          "chips reshape to (dp, tp)")
+    try:
+        stage_submeshes([len(devs) + 1], devs)
+        check(False, "overcommitted sub-mesh must raise")
+    except ValueError:
+        check(True, "overcommitted sub-mesh raises")
+
+    print("mesh smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

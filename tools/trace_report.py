@@ -128,6 +128,10 @@ def _print_human(report: Dict[str, Any]) -> None:
     print(f"# bubble fraction {report['bubble_fraction'] * 100:.1f}% | "
           f"critical path {report['critical_path_ms']:.2f} ms | "
           f"pure stall {report['pure_stall_ms']:.2f} ms")
+    if "dispatch" in report:
+        d = report["dispatch"]
+        print(f"# host dispatch share {d['share'] * 100:.1f}% "
+              f"({d['total_ms']:.2f} ms over {d['steps']} steps)")
     if "steps" in report:
         s = report["steps"]
         print(f"# steps: n={s['count']} mean {s['mean_ms']:.2f} ms "
