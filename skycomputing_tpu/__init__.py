@@ -34,9 +34,10 @@ from .dynamics import (
     Worker,
     WorkerManager,
 )
-from .fleet import FleetSupervisor, Router, ServingFleet
+from .fleet import FleetAutoscaler, FleetSupervisor, Router, ServingFleet
 from .parallel import MeshPipelineModel, PipelineModel, StageRuntime
 from .runner import AutotuneHook, Hook, Runner
+from .workload import Scenario, ScenarioPlayer, get_scenario
 from .serving import (
     ChunkBudgetPolicy,
     DraftModel,
@@ -99,8 +100,12 @@ __all__ = [
     "Request",
     "ServingEngine",
     "ServingFleet",
+    "FleetAutoscaler",
     "FleetSupervisor",
     "Router",
+    "Scenario",
+    "ScenarioPlayer",
+    "get_scenario",
     "ServingAutotuner",
     "TuningAdvisor",
     "Stimulator",

@@ -187,6 +187,12 @@ MANIFEST: Dict[str, Any] = {
                    "may_import": ["telemetry", "utils"]},
         "fleet": {"modules": ["skycomputing_tpu.fleet"],
                   "may_import": ["serving", "telemetry", "utils"]},
+        # the workload plane sits BESIDE the fleet, not above it: the
+        # player drives fleets/engines duck-typed, so its only direct
+        # edge is serving (Request materialization); the scenario core
+        # is pure stdlib (below)
+        "workload": {"modules": ["skycomputing_tpu.workload"],
+                     "may_import": ["serving"]},
         "tools": {"modules": ["tools"], "may_import": ["*"]},
     },
     # stdlib-only by contract: loadable by FILE PATH on a bare runner
@@ -207,12 +213,20 @@ MANIFEST: Dict[str, Any] = {
         "skycomputing_tpu.telemetry.slo",
         "skycomputing_tpu.telemetry.timeseries",
         "skycomputing_tpu.telemetry.tracer",
+        # the scenario core + named catalog (one self-contained file so
+        # tools/workload_smoke.py can file-path-load it on a bare
+        # runner; the numpy-backed player/mixes live in sibling modules
+        # outside this contract)
+        "skycomputing_tpu.workload.scenario",
     ],
     # CLI entry points that must START with stdlib only (their package
     # imports live in try/except fallbacks — guarded imports are exempt)
     "file_path_tools": [
         "tools.bench_autotune",
         "tools.bench_fleet",
+        # scenario bench: --list works on a bare runner (file-path
+        # catalog fallback); the gated run imports jax inside run_bench
+        "tools.bench_scenarios",
         "tools.changed",
         "tools.chunk_smoke",
         # mesh-shape-search contracts (file-path-loads dynamics/solver);
@@ -229,6 +243,7 @@ MANIFEST: Dict[str, Any] = {
         "tools.skyaudit",
         "tools.skylint",
         "tools.trace_report",
+        "tools.workload_smoke",
     ],
     # (source prefix, target prefix, rationale) — checked on the
     # TRANSITIVE closure of top-level imports, chain in the diagnostic

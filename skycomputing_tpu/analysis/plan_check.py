@@ -954,6 +954,98 @@ def _pos_int(v) -> bool:
     )
 
 
+#: scale-decision actions the fleet autoscaler may propose
+SCALE_ADD = "add"
+SCALE_REMOVE = "remove"
+
+
+def verify_scale_payload(scale: Any) -> List[str]:
+    """Problems with a fleet scale decision (empty = valid).
+
+    Schema — what :class:`~..fleet.autoscaler.FleetAutoscaler` emits
+    before ANY fleet mutation: ``action`` (``"add"`` | ``"remove"``),
+    ``replicas`` (current live replica count, positive int), ``delta``
+    (positive int, how many replicas the decision moves), optional
+    ``min_replicas`` / ``max_replicas`` bounds (positive ints,
+    ``min <= max``), and for ADDs a chip-budget feasibility pair:
+    ``chips_required`` (positive int) must fit ``chips_free``
+    (non-negative int) — an add the device pool cannot back dies HERE,
+    with the fleet untouched, exactly like an infeasible re-form dies
+    in its builder's pre-flight.  REMOVEs must keep the fleet at or
+    above ``min_replicas`` (and never below one replica: an empty
+    fleet cannot serve the drain).  This is the verify-then-apply gate
+    every autoscaler decision passes through before it becomes a
+    mutation.
+    """
+    problems: List[str] = []
+    if not isinstance(scale, dict):
+        return [
+            f"scale decision must be an object, got "
+            f"{type(scale).__name__}"
+        ]
+    action = scale.get("action")
+    if action not in (SCALE_ADD, SCALE_REMOVE):
+        problems.append(
+            f"scale.action must be {SCALE_ADD!r} or {SCALE_REMOVE!r}, "
+            f"got {action!r}"
+        )
+    replicas = scale.get("replicas")
+    if not _pos_int(replicas):
+        problems.append(
+            f"scale.replicas must be a positive int (the current live "
+            f"count), got {replicas!r}"
+        )
+    delta = scale.get("delta")
+    if not _pos_int(delta):
+        problems.append(
+            f"scale.delta must be a positive int, got {delta!r}"
+        )
+    lo, hi = scale.get("min_replicas"), scale.get("max_replicas")
+    for key, v in (("min_replicas", lo), ("max_replicas", hi)):
+        if v is not None and not _pos_int(v):
+            problems.append(
+                f"scale.{key} must be a positive int, got {v!r}"
+            )
+    if _pos_int(lo) and _pos_int(hi) and lo > hi:
+        problems.append(
+            f"scale.min_replicas ({lo}) exceeds max_replicas ({hi})"
+        )
+    if problems:
+        return problems
+    if action == SCALE_ADD:
+        if _pos_int(hi) and replicas + delta > hi:
+            problems.append(
+                f"adding {delta} to {replicas} replicas exceeds "
+                f"max_replicas={hi}"
+            )
+        required = scale.get("chips_required")
+        free = scale.get("chips_free")
+        if not _pos_int(required):
+            problems.append(
+                f"scale.chips_required must be a positive int for an "
+                f"add, got {required!r}"
+            )
+        if (isinstance(free, bool) or not isinstance(free, int)
+                or free < 0):
+            problems.append(
+                f"scale.chips_free must be a non-negative int for an "
+                f"add, got {free!r}"
+            )
+        if not problems and required > free:
+            problems.append(
+                f"no chip budget: the add needs {required} chip(s) but "
+                f"only {free} are free — rejected before any mutation"
+            )
+    else:
+        floor = lo if _pos_int(lo) else 1
+        if replicas - delta < max(floor, 1):
+            problems.append(
+                f"removing {delta} from {replicas} replicas would drop "
+                f"below min_replicas={max(floor, 1)}"
+            )
+    return problems
+
+
 def _verify_serving_payload(serving: Any) -> List[str]:
     """Problems with a payload's optional ``serving`` operating point.
 
@@ -1190,6 +1282,7 @@ __all__ = [
     "has_plan",
     "verify_allocation_payload",
     "verify_mesh_payload",
+    "verify_scale_payload",
     "verify_pipeline",
     "verify_plan",
     "verify_tuning_knobs",

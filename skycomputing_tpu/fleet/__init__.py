@@ -16,7 +16,10 @@ self-heal.
   ``fleet_heal`` trace arcs);
 - :mod:`.fleet` — :class:`ServingFleet`, the orchestrator, with
   :class:`FleetStats` and a fleet-wide :class:`~..telemetry.
-  MetricsRegistry`.
+  MetricsRegistry`;
+- :mod:`.autoscaler` — :class:`FleetAutoscaler`, sustained SLO burn ->
+  verified replica ADD, sustained slack -> drain-then-REMOVE, with
+  hysteresis + cooldown and a ``plan_check`` scale pre-flight.
 """
 
 from __future__ import annotations
@@ -27,6 +30,7 @@ from .admission import (
     BATCH,
     INTERACTIVE,
 )
+from .autoscaler import FleetAutoscaler
 from .fleet import FleetStats, ServingFleet
 from .replica import EngineReplica, ReplicaCrashed
 from .router import Router, prefix_key, replica_load
@@ -37,6 +41,7 @@ __all__ = [
     "AdmitDecision",
     "BATCH",
     "EngineReplica",
+    "FleetAutoscaler",
     "FleetStats",
     "FleetSupervisor",
     "INTERACTIVE",

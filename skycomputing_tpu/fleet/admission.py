@@ -91,6 +91,16 @@ class AdmissionController:
         self.queue_factor = float(queue_factor)
         self.shed_fraction = float(shed_fraction)
         self.service_s_estimate = float(service_s_estimate)
+        # the slot capacity an explicit max_pending was SIZED FOR.  The
+        # fleet stamps its construction-time capacity here, and
+        # pending_bound() re-scales the explicit bound by live/baseline
+        # — so when the autoscaler adds replicas the bound loosens with
+        # them (capacity the admission gate never uses is capacity
+        # wasted), and when replicas die it tightens, which is exactly
+        # when admission must tighten.  None (the default, and every
+        # directly-constructed controller) keeps the explicit bound
+        # fixed, the historical behavior.
+        self.baseline_capacity: Optional[int] = None
         # optional online-SLO signal (telemetry.slo.SloMonitor, but
         # DUCK-TYPED — this module stays pure stdlib / file-path
         # loadable): while any declared SLO burns, the pending bound
@@ -106,14 +116,24 @@ class AdmissionController:
                     and getattr(self.slo_monitor, "firing", ()))
 
     def pending_bound(self, capacity_slots: int) -> int:
-        """The effective pending bound for the current live capacity.
+        """The effective pending bound for the current LIVE capacity.
 
-        An explicit ``max_pending`` wins; otherwise ``queue_factor ×``
-        the healthy fleet's slot capacity — the bound shrinks when
-        replicas die, which is exactly when admission must tighten.
+        An explicit ``max_pending`` wins — re-scaled by
+        ``capacity_slots / baseline_capacity`` when the fleet stamped
+        the baseline it was sized for, so the bound tracks healthy-
+        replica capacity as the fleet scales (or loses replicas)
+        instead of freezing at its construction-time value.  Otherwise
+        ``queue_factor ×`` the healthy fleet's slot capacity — which
+        shrinks when replicas die, exactly when admission must tighten.
         A firing SLO monitor tightens either form by ``slo_tighten``."""
         if self.max_pending is not None:
             bound = self.max_pending
+            base = self.baseline_capacity
+            if base and base > 0 and capacity_slots >= 0 \
+                    and capacity_slots != base:
+                bound = max(1, int(round(
+                    bound * capacity_slots / base
+                )))
         else:
             bound = max(1, int(self.queue_factor * max(capacity_slots, 0)))
         if self._slo_burning():

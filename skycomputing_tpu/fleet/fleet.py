@@ -59,7 +59,7 @@ from .replica import (
     ReplicaCrashed,
 )
 from .router import Router
-from .supervisor import FleetSupervisor
+from .supervisor import FleetSupervisor, REFORMED
 
 
 @dataclass
@@ -84,9 +84,22 @@ class FleetStats:
     reform_failures: int = 0
     missed_beats: int = 0
     ticks: int = 0
+    # autoscaler outcomes: replicas added / drained-and-removed /
+    # decisions the pre-flight (or the verified build) rejected with
+    # the fleet untouched — scale events must be as countable as
+    # rejections, or "it scaled down overnight" is unexplainable
+    scale_ups: int = 0
+    scale_downs: int = 0
+    scale_rejected: int = 0
     # gauges (last step)
     replicas_healthy: int = 0
+    replicas_total: int = 0
     pending: int = 0
+    #: queued-but-unserved backlog (replica queues + limbo, running
+    #: excluded) — the overload gauge SLO targets should burn on:
+    #: ``pending`` includes running work, so a full-but-keeping-up
+    #: fleet reads high on it by design
+    queue_depth: int = 0
     limbo_depth: int = 0
 
     def count_rejection(self, reason: str) -> None:
@@ -107,7 +120,10 @@ class FleetStats:
         "failed": "counter", "reforms": "counter",
         "reform_failures": "counter", "missed_beats": "counter",
         "ticks": "counter",
-        "replicas_healthy": "gauge", "pending": "gauge",
+        "scale_ups": "counter", "scale_downs": "counter",
+        "scale_rejected": "counter",
+        "replicas_healthy": "gauge", "replicas_total": "gauge",
+        "pending": "gauge", "queue_depth": "gauge",
         "limbo_depth": "gauge",
         "ttft_p50_s": "gauge", "ttft_p95_s": "gauge",
         "tpot_p50_s": "gauge", "tpot_p95_s": "gauge",
@@ -126,8 +142,13 @@ class FleetStats:
             reform_failures=self.reform_failures,
             missed_beats=self.missed_beats,
             ticks=self.ticks,
+            scale_ups=self.scale_ups,
+            scale_downs=self.scale_downs,
+            scale_rejected=self.scale_rejected,
             replicas_healthy=self.replicas_healthy,
+            replicas_total=self.replicas_total,
             pending=self.pending,
+            queue_depth=self.queue_depth,
             limbo_depth=self.limbo_depth,
         )
 
@@ -157,6 +178,7 @@ class ServingFleet(LiveMetricsMixin):
         admission: Optional[AdmissionController] = None,
         supervisor: Optional[FleetSupervisor] = None,
         fault_injector=None,
+        autoscaler=None,
         devices: Optional[Sequence[Any]] = None,
         finished_history: int = 4096,
         slo_window: int = 2048,
@@ -171,30 +193,35 @@ class ServingFleet(LiveMetricsMixin):
         )
         self.fault_injector = fault_injector
         self.stats = FleetStats()
-        shared = dict(engine_kwargs or {})
+        # kept for replica ADDs: a scaled-up replica is built through
+        # the SAME shared-operating-point builder path the fleet booted
+        # with (and the same serving pre-flight), not a parallel one
+        self._model_cfg = model_cfg
+        self._params_list = params_list
+        self._shared_kwargs = dict(engine_kwargs or {})
+        self._devices = (list(devices) if devices is not None
+                         else list(jax.devices()))
         if replica_specs is None:
-            devs = list(devices) if devices is not None else jax.devices()
             replica_specs = [
-                dict(devices=[devs[i % len(devs)]])
+                dict(devices=[self._devices[i % len(self._devices)]])
                 for i in range(int(replicas))
             ]
         if not replica_specs:
             raise ValueError("a fleet needs at least one replica")
-
-        def make_builder(spec: Dict[str, Any]):
-            merged = dict(shared)
-            merged.update(spec)
-
-            def build() -> ServingEngine:
-                return ServingEngine(model_cfg, params_list, **merged)
-
-            return build
-
         self.replicas: List[EngineReplica] = [
-            EngineReplica(f"replica{i}", make_builder(spec))
+            EngineReplica(f"replica{i}", self._make_builder(spec))
             for i, spec in enumerate(replica_specs)
         ]
         self._by_name = {r.name: r for r in self.replicas}
+        # per-replica placement specs (chip accounting for the scale
+        # pre-flight) + a monotonic name sequence: replica names are
+        # never reused, so supervisor telemetry and metric sources
+        # can't alias across scale events
+        self._specs: Dict[str, Dict[str, Any]] = {
+            r.name: dict(spec)
+            for r, spec in zip(self.replicas, replica_specs)
+        }
+        self._replica_seq = len(self.replicas)
         self.tick = 0
         # fleet ledger: every admitted, unfinished request — the source
         # of truth a dead replica's recovery reads (Request objects
@@ -238,6 +265,28 @@ class ServingFleet(LiveMetricsMixin):
         self._exporter = None
         if slo is not None:
             self.attach_slo(slo)
+        # the explicit admission bound was sized for THIS capacity;
+        # stamping the baseline lets pending_bound() track live
+        # healthy-replica capacity as the fleet scales (an explicit
+        # baseline set by the caller wins)
+        if getattr(self.admission, "baseline_capacity", None) is None:
+            self.admission.baseline_capacity = self._capacity_slots()
+        self.autoscaler = None
+        if autoscaler is not None:
+            self.attach_autoscaler(autoscaler)
+
+    def _make_builder(self, spec: Dict[str, Any]):
+        """Zero-arg engine builder for one replica spec merged over the
+        fleet's shared operating point — the verified-construction
+        callable both boot and every later re-form/scale-up run."""
+        merged = dict(self._shared_kwargs)
+        merged.update(spec)
+
+        def build() -> ServingEngine:
+            return ServingEngine(self._model_cfg, self._params_list,
+                                 **merged)
+
+        return build
 
     # --- live observability (LiveMetricsMixin + the SLO leg) ----------------
     #: fleet ticks are the finest sampling grain in the repo; keep a
@@ -266,6 +315,16 @@ class ServingFleet(LiveMetricsMixin):
         if getattr(self.supervisor, "slo_monitor", None) is None:
             self.supervisor.slo_monitor = monitor
         return monitor
+
+    def attach_autoscaler(self, autoscaler):
+        """Wire a :class:`~.autoscaler.FleetAutoscaler` into the fleet
+        loop: ``step()`` polls it after the SLO monitor has judged the
+        tick, so every decision reads this tick's freshest burn/slack
+        evidence."""
+        if self.autoscaler is not None:
+            raise ValueError("an autoscaler is already attached")
+        self.autoscaler = autoscaler
+        return autoscaler
 
     def _health_snapshot(self) -> Dict[str, Any]:
         """The ``/healthz`` body: per-replica lifecycle states plus an
@@ -305,6 +364,123 @@ class ServingFleet(LiveMetricsMixin):
             r.engine.stats.queue_depth for r in self.healthy_replicas
         )
         return depth + len(self._limbo)
+
+    # --- replica scale-up / scale-down (driven by the autoscaler) -----------
+    def chip_capacity(self) -> int:
+        """Total chips this fleet may place replicas on (the device
+        pool it was constructed over)."""
+        return len(self._devices)
+
+    def _replica_chips(self, name: str) -> int:
+        devs = self._specs.get(name, {}).get("devices")
+        return len(devs) if devs else 1
+
+    def chips_in_use(self) -> int:
+        """Chips held by every live (non-retired) replica — what the
+        scale pre-flight subtracts from :meth:`chip_capacity`."""
+        return sum(self._replica_chips(r.name) for r in self.replicas
+                   if r.state != RETIRED)
+
+    def add_replica(
+        self, spec: Optional[Dict[str, Any]] = None
+    ) -> EngineReplica:
+        """Verified scale-up: one new replica through the supervisor's
+        budgeted re-form machinery.
+
+        The replica is created PROVISIONAL (no engine, parked DEAD) and
+        only becomes HEALTHY through ``FleetSupervisor``'s
+        ``_attempt_reform`` path — the same verified builder + serving
+        pre-flight a post-crash re-form runs, with the same trace arcs.
+        A rejected build unwinds structurally: the provisional replica
+        is dropped, no metric source was registered, no request was
+        ever routable to it — the fleet is exactly as before, and the
+        caller (the autoscaler) counts the rejection."""
+        if spec is None:
+            spec = dict(devices=[
+                self._devices[self._replica_seq % len(self._devices)]
+            ])
+        name = f"replica{self._replica_seq}"
+        replica = EngineReplica(name, self._make_builder(spec),
+                                defer_build=True)
+        self.replicas.append(replica)
+        self._by_name[name] = replica
+        self._specs[name] = dict(spec)
+        self._replica_seq += 1
+        outcome = self.supervisor.retry_reform(self, replica)
+        if outcome != REFORMED:
+            # structural rollback: the provisional replica never held
+            # an engine, a request, or a metric source
+            self.replicas = [r for r in self.replicas
+                             if r is not replica]
+            self._by_name.pop(name, None)
+            self._specs.pop(name, None)
+            self.supervisor.forget_replica(name)
+            raise RuntimeError(
+                f"scale-up replica {name} was rejected by the verified "
+                f"build ({outcome})"
+            )
+        self.metrics.register(name, replica.stats_snapshot,
+                              types=type(replica).FIELD_TYPES)
+        self.stats.replicas_total = len(self.replicas)
+        self._logger.info(
+            f"ServingFleet: replica {name} added "
+            f"(devices={spec.get('devices')})"
+        )
+        return replica
+
+    def remove_replica(self, name: str) -> str:
+        """Drain-then-remove scale-down; returns ``"removed"`` when the
+        replica left immediately or ``"draining"`` when it is finishing
+        requests that could not migrate (the supervisor finalizes the
+        removal once the drain empties).  Token streams survive exactly
+        as they do a sick-replica heal: graceful preempt, forced
+        redispatch onto survivors."""
+        replica = self._by_name.get(name)
+        if replica is None:
+            raise ValueError(f"unknown replica {name!r}")
+        if replica.pending_removal:
+            return "draining"
+        survivors = [r for r in self.replicas
+                     if r.state == HEALTHY and r is not replica]
+        if not survivors:
+            raise ValueError(
+                f"cannot remove {name}: it is the last healthy replica"
+            )
+        replica.pending_removal = True
+        migrated = self.drain_replica(replica, dead=False)
+        # out of rotation BEFORE redispatch, so the migrated requests
+        # can only land on survivors
+        replica.state = DRAINING
+        self.router.forget_replica(name)
+        self.redispatch(migrated)
+        if replica.engine.running_requests:
+            return "draining"
+        self.finalize_removal(replica)
+        return "removed"
+
+    def finalize_removal(self, replica: EngineReplica) -> None:
+        """Drop a fully-drained replica from the fleet (chips
+        released, metric source unregistered, name never reused)."""
+        replica.state = RETIRED
+        replica.pending_removal = False
+        self.replicas = [r for r in self.replicas if r is not replica]
+        self._by_name.pop(replica.name, None)
+        self._specs.pop(replica.name, None)
+        self.router.forget_replica(replica.name)
+        self.supervisor.forget_replica(replica.name)
+        self.metrics.unregister(replica.name)
+        self.stats.replicas_total = len(self.replicas)
+        self._logger.info(
+            f"ServingFleet: replica {replica.name} removed"
+        )
+
+    def reset_slo_windows(self) -> None:
+        """Forget the rolling TTFT/TPOT samples (benches call this
+        after compile warmup: a warm request's TTFT is dominated by
+        bucket compiles and would sit in the percentile window —
+        and therefore in every SLO verdict — for the whole run)."""
+        self._ttft_window.clear()
+        self._tpot_window.clear()
 
     @staticmethod
     def _window_percentile(window: deque, q: float) -> Optional[float]:
@@ -645,14 +821,20 @@ class ServingFleet(LiveMetricsMixin):
         self._sweep_terminal()
         self.stats.ticks += 1
         self.stats.replicas_healthy = len(self.healthy_replicas)
+        self.stats.replicas_total = len(self.replicas)
         self.stats.pending = len(self._pending)
+        self.stats.queue_depth = self._pending_depth()
         self.stats.limbo_depth = len(self._limbo)
         # observability tail: sample the tick's final state, then judge
-        # it — the SLO monitor must see the sample it alerts on
+        # it — the SLO monitor must see the sample it alerts on, and
+        # the autoscaler polls LAST so its sustained-burn/slack
+        # evidence includes this very tick's verdict
         if self.timeseries is not None:
             self.timeseries.sample()
         if self.slo is not None:
             self.slo.evaluate(get_tracer())
+        if self.autoscaler is not None:
+            self.autoscaler.poll(self)
         self.tick += 1
 
     def _sweep_terminal(self) -> None:

@@ -46,15 +46,33 @@ class EngineReplica:
     FIELD_TYPES = {**ServingStats.FIELD_TYPES, "generation": "gauge"}
 
     def __init__(self, name: str,
-                 build_engine: Callable[[], ServingEngine]):
+                 build_engine: Callable[[], ServingEngine],
+                 *, defer_build: bool = False):
         self.name = str(name)
         self._build = build_engine
-        self.engine: ServingEngine = build_engine()
-        # request-scoped trace spans attribute their segments to the
-        # replica, not the anonymous "engine"
-        self.engine.trace_name = self.name
-        self.state = HEALTHY
-        self.generation = 0
+        if defer_build:
+            # a PROVISIONAL replica (fleet scale-up): no engine yet,
+            # parked DEAD so the only way it can ever serve is through
+            # the supervisor's budgeted verify-then-apply re-form path
+            # (_attempt_reform -> rebuild) — an autoscaler ADD is the
+            # same verified construction as a post-crash re-form, by
+            # reuse.  generation -1 so the first successful build lands
+            # at 0, exactly like an eagerly-built replica.
+            self.engine: Optional[ServingEngine] = None
+            self.state = DEAD
+            self.generation = -1
+        else:
+            self.engine = build_engine()
+            # request-scoped trace spans attribute their segments to
+            # the replica, not the anonymous "engine"
+            self.engine.trace_name = self.name
+            self.state = HEALTHY
+            self.generation = 0
+        # set by the autoscaler's drain-then-remove: a DRAINING replica
+        # flagged here is finishing its last requests on the way OUT of
+        # the fleet — the supervisor must finalize the removal when the
+        # drain empties, never re-form it
+        self.pending_removal = False
         # monotonic counter discipline across re-forms: a rebuilt
         # engine starts a fresh ServingStats, but the REPLICA's
         # counters must never go backwards mid-run or every
@@ -138,6 +156,13 @@ class EngineReplica:
     def snapshot(self) -> dict:
         """The router/admission view of this replica (plain scalars,
         feeds the fleet ``MetricsRegistry`` too)."""
+        if self.engine is None:
+            # provisional replica mid-scale-up: visible, never routable
+            return dict(name=self.name, healthy=False,
+                        state=self.state, generation=self.generation,
+                        slots=0, free_slots=0, queue_depth=0,
+                        running=0, ttft_p95_s=None, tpot_p50_s=None,
+                        tpot_p95_s=None)
         pool = self.engine.stages[0].pool
         stats = self.engine.stats
         w = self.SNAPSHOT_WINDOW
@@ -201,12 +226,15 @@ class EngineReplica:
         # bank the dying generation's cumulative counters BEFORE the
         # swap (the stats object is still readable even for a crashed
         # replica — the crash is simulated at the RPC surface), so
-        # stats_snapshot() stays monotonic across the re-form
-        old = self.engine.stats
-        for field in ServingStats.COUNTER_FIELDS:
-            self._carried[field] = (
-                self._carried.get(field, 0) + getattr(old, field)
-            )
+        # stats_snapshot() stays monotonic across the re-form.  A
+        # provisional (defer_build) replica has no prior generation to
+        # bank.
+        if self.engine is not None:
+            old = self.engine.stats
+            for field in ServingStats.COUNTER_FIELDS:
+                self._carried[field] = (
+                    self._carried.get(field, 0) + getattr(old, field)
+                )
         engine.trace_name = self.name
         self.engine = engine
         self.state = HEALTHY

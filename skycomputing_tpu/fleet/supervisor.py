@@ -159,6 +159,15 @@ class FleetSupervisor:
         compile warmup, new normal)."""
         self._health[replica.name] = _Health()
 
+    def forget_replica(self, name: str) -> None:
+        """Drop ALL per-replica state for a name that left the fleet
+        for good (autoscaler removal / rolled-back add).  Names are
+        never reused, so without this an always-on autoscaled fleet
+        minting fresh names every diurnal cycle grows these dicts
+        without bound."""
+        self._health.pop(name, None)
+        self._reform_attempts.pop(name, None)
+
     # --- detection ----------------------------------------------------------
     def _diagnose(self, replica: EngineReplica) -> Optional[str]:
         if replica.crashed or (
@@ -188,7 +197,9 @@ class FleetSupervisor:
                            and getattr(self.slo_monitor, "firing", ()))
         if fleet.tick % self.check_every != 0 and not slo_burning:
             return
-        for replica in fleet.replicas:
+        # snapshot the list: finishing a pending removal mutates
+        # fleet.replicas mid-walk
+        for replica in list(fleet.replicas):
             if replica.state == HEALTHY:
                 reason = self._diagnose(replica)
                 if reason is not None:
@@ -196,14 +207,25 @@ class FleetSupervisor:
             elif replica.state == DRAINING:
                 # finishing the requests that could not migrate; a crash
                 # mid-drain escalates to the dead path, an empty engine
-                # graduates to re-form
+                # graduates to re-form — or, for a replica the
+                # autoscaler is removing, to leaving the fleet
                 if (replica.crashed or replica.missed_beats
                         >= self.heartbeat_misses):
-                    self.heal(fleet, replica, REASON_DEAD)
+                    if replica.pending_removal:
+                        self.finish_removal(fleet, replica, dead=True)
+                    else:
+                        self.heal(fleet, replica, REASON_DEAD)
                 elif not replica.engine.running_requests:
-                    self.retry_reform(fleet, replica)
+                    if replica.pending_removal:
+                        self.finish_removal(fleet, replica, dead=False)
+                    else:
+                        self.retry_reform(fleet, replica)
             elif replica.state in (DEAD, EVICTED):
-                self.retry_reform(fleet, replica)
+                if replica.pending_removal:
+                    self.finish_removal(fleet, replica,
+                                        dead=replica.state == DEAD)
+                else:
+                    self.retry_reform(fleet, replica)
 
     # --- recovery -----------------------------------------------------------
     def _record(self, kind: str, replica: EngineReplica, tick: int,
@@ -283,6 +305,36 @@ class FleetSupervisor:
             tracer.async_end("fleet_heal", lane, self._arc_id,
                              dict({"outcome": outcome}, **detail))
         return outcome
+
+    def finish_removal(self, fleet, replica: EngineReplica,
+                       *, dead: bool) -> None:
+        """Complete an autoscaler scale-down whose drain has finished
+        (or whose replica died mid-drain: its ledger requests are
+        recovered first — a removal must lose exactly as many tokens
+        as a heal, zero).  The replica leaves the fleet for good."""
+        tracer = get_tracer()
+        lane = (tracer.lane("fleet", "autoscaler")
+                if tracer is not None else None)
+        if dead:
+            if tracer is not None:
+                with tracer.span("fleet.drain", lane,
+                                 {"replica": replica.name,
+                                  "dead": True, "removal": True}):
+                    migrated = fleet.drain_replica(replica, dead=True)
+            else:
+                migrated = fleet.drain_replica(replica, dead=True)
+            fleet.redispatch(migrated)
+        fleet.finalize_removal(replica)
+        self._record("removed", replica, fleet.tick, dead=dead)
+        self._logger.info(
+            f"FleetSupervisor: replica {replica.name} removed "
+            f"(scale-down{' after mid-drain death' if dead else ''})"
+        )
+        if tracer is not None:
+            tracer.instant(
+                "scale_down_complete", lane,
+                {"replica": replica.name, "dead": dead},
+            )
 
     def retry_reform(self, fleet, replica: EngineReplica) -> str:
         """A fresh re-form attempt for a replica stranded by an earlier

@@ -128,7 +128,8 @@ class SloMonitor:
 
     #: registry classification for the scalar snapshot fields
     FIELD_TYPES = {"alerts_total": "counter", "evaluations": "counter",
-                   "firing": "gauge"}
+                   "firing": "gauge", "firing_streak": "gauge",
+                   "quiet_streak": "gauge"}
 
     def __init__(self, targets: List[SloTarget],
                  timeseries: Any = None):
@@ -143,6 +144,12 @@ class SloMonitor:
         #: artifact can tell "burned during the spike" from "never
         #: burned" even after the alert cleared)
         self.fired_ever: set = set()
+        #: consecutive evaluations with >= 1 firing target / with none
+        #: — the SUSTAINED-burn vs SUSTAINED-slack surface the fleet
+        #: autoscaler consumes (one blip never moves a replica; only a
+        #: streak does).  Gauges: they saw-tooth by design.
+        self.firing_streak = 0
+        self.quiet_streak = 0
         self._firing: Dict[str, SloAlert] = {}
         self._last: Dict[str, SloAlert] = {}
 
@@ -221,6 +228,12 @@ class SloMonitor:
                     )
             self._last[target.name] = alert
         self.evaluations += 1
+        if self._firing:
+            self.firing_streak += 1
+            self.quiet_streak = 0
+        else:
+            self.quiet_streak += 1
+            self.firing_streak = 0
         return alerts
 
     # --- MetricsRegistry source ---------------------------------------------
@@ -232,6 +245,8 @@ class SloMonitor:
             alerts_total=self.alerts_total,
             evaluations=self.evaluations,
             firing=len(self._firing),
+            firing_streak=self.firing_streak,
+            quiet_streak=self.quiet_streak,
         )
         for name, alert in self._last.items():
             out[name] = dict(

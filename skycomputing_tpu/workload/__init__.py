@@ -1,0 +1,62 @@
+"""The workload plane: seeded, replayable load generation.
+
+- :mod:`.scenario` — the PURE-STDLIB core: :class:`Dist` /
+  :class:`Phase` / :class:`Scenario` declare a workload; one seeded
+  ``random.Random`` lowers it to a byte-reproducible arrival trace
+  (``tools/workload_smoke.py`` file-path-loads this on a bare runner);
+- :mod:`.catalog` — the named-scenario registry (``diurnal_ramp``,
+  ``flash_crowd``, ``tenant_mix``, ``rag_shared_prefix``,
+  ``length_skew``), one ``--scenario`` flag per workload;
+- :mod:`.player` — :class:`ScenarioPlayer` replays a trace against a
+  duck-typed fleet/engine target, recording per-request verdicts;
+- :mod:`.mixes` — the benches' pre-plane numpy workloads under stable
+  names, draw-order-compatible with the committed artifacts.
+
+The heavy halves (player/mixes need numpy) import lazily so the
+stdlib core stays importable anywhere the telemetry core is.
+"""
+
+from __future__ import annotations
+
+from .catalog import (
+    SCENARIOS,
+    get_scenario,
+    register_scenario,
+    scenario_names,
+)
+from .scenario import (
+    Arrival,
+    BATCH,
+    Dist,
+    INTERACTIVE,
+    Phase,
+    PrefixPool,
+    Scenario,
+)
+
+try:  # numpy-backed halves; absent on bare stdlib-only runners
+    from .mixes import MIXES, build_mix
+    from .player import PlayerReport, PlayerVerdict, ScenarioPlayer
+except ImportError:  # pragma: no cover - exercised on bare runners
+    MIXES = None  # type: ignore[assignment]
+    build_mix = None  # type: ignore[assignment]
+    PlayerReport = PlayerVerdict = ScenarioPlayer = None  # type: ignore
+
+__all__ = [
+    "Arrival",
+    "BATCH",
+    "Dist",
+    "INTERACTIVE",
+    "MIXES",
+    "Phase",
+    "PlayerReport",
+    "PlayerVerdict",
+    "PrefixPool",
+    "SCENARIOS",
+    "Scenario",
+    "ScenarioPlayer",
+    "build_mix",
+    "get_scenario",
+    "register_scenario",
+    "scenario_names",
+]

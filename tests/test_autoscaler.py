@@ -1,0 +1,337 @@
+"""Fleet-autoscaler contracts (CPU-deterministic, tier-1).
+
+The autoscaler closes the SLO loop at replica granularity, and its
+correctness story is the fleet's: every mutation is VERIFIED before it
+happens (plan_check scale pre-flight, the supervisor's budgeted
+re-form build) and every request's token stream survives it exactly
+(drain-then-remove rides the same migrate machinery as a heal).  These
+tests pin the sustained-burn -> add path with hysteresis + cooldown,
+the sustained-slack -> drain-then-remove path down to ``min_replicas``,
+infeasible adds leaving the fleet untouched, the scale-payload schema,
+the admission bound tracking live capacity, and token identity across
+mid-scenario scale events.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from skycomputing_tpu.analysis.plan_check import verify_scale_payload
+from skycomputing_tpu.builder import build_layer_stack
+from skycomputing_tpu.fleet import (
+    AdmissionController,
+    FleetAutoscaler,
+    FleetSupervisor,
+    ServingFleet,
+)
+from skycomputing_tpu.models.gpt import (
+    GptConfig,
+    generate,
+    gpt_layer_configs,
+)
+from skycomputing_tpu.serving import Request
+from skycomputing_tpu.workload import Dist, Phase, Scenario, ScenarioPlayer
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    cfg = GptConfig(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=2, max_position_embeddings=64,
+                    dropout_prob=0.0, dtype="float32")
+    layer_cfgs = gpt_layer_configs(cfg, deterministic=True)
+    stack = build_layer_stack(layer_cfgs)
+    params = stack.init(jax.random.key(7), np.ones((1, 5), np.int32))
+    fwd = jax.jit(lambda ids: stack.apply(params, ids))
+    return layer_cfgs, params, fwd
+
+
+class StubSlo:
+    """Duck-typed burn source: the autoscaler reads ``firing`` /
+    ``firing_streak`` and the fleet loop calls ``evaluate`` — a stub
+    makes the burn evidence a test INPUT instead of a wall-clock
+    emergent."""
+
+    def __init__(self):
+        self.firing = ()
+        self.firing_streak = 0
+        self.quiet_streak = 0
+
+    def burn(self):
+        self.firing = ("stub_target",)
+        self.firing_streak += 1
+        self.quiet_streak = 0
+
+    def clear(self):
+        self.firing = ()
+        self.firing_streak = 0
+
+    def evaluate(self, tracer=None):
+        return []
+
+
+def make_fleet(gpt, *, replicas=1, autoscaler=None, admission=None):
+    layer_cfgs, params, _ = gpt
+    fleet = ServingFleet(
+        layer_cfgs, params, replicas=replicas,
+        engine_kwargs=dict(num_slots=2, max_len=64, buckets=(16, 32),
+                           prefill_batch=1),
+        admission=admission or AdmissionController(),
+        supervisor=FleetSupervisor(check_every=1),
+        autoscaler=autoscaler,
+    )
+    fleet.slo = StubSlo()  # duck-typed; attach_slo needs a real monitor
+    return fleet
+
+
+def requests(rng, n, lo=4, hi=14, new_lo=3, new_hi=6):
+    return [
+        Request(prompt=rng.integers(1, 500,
+                                    (int(rng.integers(lo, hi)),)
+                                    ).astype(np.int32),
+                max_new_tokens=int(rng.integers(new_lo, new_hi)))
+        for _ in range(n)
+    ]
+
+
+# --------------------------------------------------------------------------
+# the scale pre-flight schema (pure)
+# --------------------------------------------------------------------------
+
+
+def test_verify_scale_payload_contract():
+    ok_add = dict(action="add", replicas=2, delta=1, min_replicas=1,
+                  max_replicas=4, chips_required=1, chips_free=2)
+    assert verify_scale_payload(ok_add) == []
+    ok_rm = dict(action="remove", replicas=3, delta=1, min_replicas=1)
+    assert verify_scale_payload(ok_rm) == []
+    assert verify_scale_payload("nope")  # not an object
+    assert any("action" in p for p in verify_scale_payload(
+        dict(action="explode", replicas=1, delta=1)))
+    assert any("replicas" in p for p in verify_scale_payload(
+        dict(action="add", replicas=0, delta=1)))
+    assert any("delta" in p for p in verify_scale_payload(
+        dict(action="add", replicas=1, delta=True,
+             chips_required=1, chips_free=1)))
+    # no chip budget: the add dies BEFORE any mutation
+    assert any("no chip budget" in p for p in verify_scale_payload(
+        dict(action="add", replicas=2, delta=1, chips_required=2,
+             chips_free=1)))
+    assert any("max_replicas" in p for p in verify_scale_payload(
+        dict(action="add", replicas=4, delta=1, max_replicas=4,
+             chips_required=1, chips_free=4)))
+    # a remove may never go below the floor (nor below one replica)
+    assert any("min_replicas" in p for p in verify_scale_payload(
+        dict(action="remove", replicas=2, delta=1, min_replicas=2)))
+    assert any("min_replicas" in p for p in verify_scale_payload(
+        dict(action="remove", replicas=1, delta=1)))
+    assert any("exceeds" in p for p in verify_scale_payload(
+        dict(action="add", replicas=1, delta=1, min_replicas=3,
+             max_replicas=2, chips_required=1, chips_free=1)))
+
+
+def test_admission_bound_tracks_live_capacity():
+    adm = AdmissionController(max_pending=8)
+    # no baseline stamped: the explicit bound is fixed (historical)
+    assert adm.pending_bound(2) == 8 and adm.pending_bound(16) == 8
+    # a fleet-stamped baseline re-scales it with live capacity: adds
+    # loosen, deaths tighten, Retry-After hints stay honest throughout
+    adm.baseline_capacity = 4
+    assert adm.pending_bound(4) == 8
+    assert adm.pending_bound(8) == 16
+    assert adm.pending_bound(2) == 4
+    assert adm.pending_bound(0) == 1
+    # the derived (queue_factor) form already tracked capacity
+    auto = AdmissionController(queue_factor=2.0)
+    auto.baseline_capacity = 4
+    assert auto.pending_bound(8) == 16
+
+
+# --------------------------------------------------------------------------
+# scale-up / scale-down E2E
+# --------------------------------------------------------------------------
+
+
+def test_scale_up_on_sustained_burn_with_cooldown_pinned(gpt):
+    auto = FleetAutoscaler(min_replicas=1, max_replicas=3, up_streak=3,
+                           down_streak=50, cooldown_ticks=6,
+                           chip_budget=8)
+    fleet = make_fleet(gpt, autoscaler=auto)
+    stub = fleet.slo
+    # one burning tick is not a trend: no mutation below up_streak
+    for _ in range(2):
+        stub.burn()
+        fleet.step()
+    assert fleet.stats.scale_ups == 0 and len(fleet.replicas) == 1
+    stub.burn()
+    fleet.step()
+    assert fleet.stats.scale_ups == 1 and len(fleet.replicas) == 2
+    up_tick = auto.events[-1]["tick"]
+    assert auto.events[-1]["kind"] == "scale_up"
+    # hysteresis: the burn CONTINUES but the cooldown window holds the
+    # fleet steady — one noisy window cannot flap it
+    for _ in range(5):
+        stub.burn()
+        fleet.step()
+    assert fleet.stats.scale_ups == 1 and len(fleet.replicas) == 2
+    # past the cooldown the still-sustained burn earns the next replica
+    stub.burn()
+    fleet.step()
+    assert fleet.stats.scale_ups == 2 and len(fleet.replicas) == 3
+    assert auto.events[-1]["tick"] >= up_tick + auto.cooldown_ticks
+    # the added replicas came through the supervisor's verified path
+    reforms = [e for e in fleet.supervisor.events
+               if e["kind"] == "reformed"]
+    assert len(reforms) >= 2
+    # names never alias: replica0 (boot) + replica1/replica2 (scale)
+    assert sorted(r.name for r in fleet.replicas) == [
+        "replica0", "replica1", "replica2"]
+    # metric sources followed the adds
+    assert "replica2" in fleet.metrics.names()
+
+
+def test_infeasible_add_rejected_leaves_fleet_untouched(gpt):
+    auto = FleetAutoscaler(min_replicas=1, max_replicas=4, up_streak=2,
+                           cooldown_ticks=4, chip_budget=1)
+    fleet = make_fleet(gpt, autoscaler=auto)
+    stub = fleet.slo
+    before = [r.name for r in fleet.replicas]
+    for _ in range(3):
+        stub.burn()
+        fleet.step()
+    assert fleet.stats.scale_rejected == 1
+    assert fleet.stats.scale_ups == 0
+    assert [r.name for r in fleet.replicas] == before
+    rej = [e for e in auto.events if e["kind"] == "scale_rejected"]
+    assert rej and any("no chip budget" in p
+                       for p in rej[0]["problems"])
+    # the rejection starts a cooldown too: no per-tick rejection spam
+    assert len(rej) == 1
+    # guards on the fleet verbs themselves
+    with pytest.raises(ValueError, match="unknown replica"):
+        fleet.remove_replica("replica99")
+    with pytest.raises(ValueError, match="last healthy replica"):
+        fleet.remove_replica("replica0")
+
+
+def test_scale_down_and_token_identity_across_scale_events(gpt):
+    layer_cfgs, params, fwd = gpt
+    auto = FleetAutoscaler(min_replicas=1, max_replicas=2, up_streak=2,
+                           down_streak=4, cooldown_ticks=3,
+                           chip_budget=8, slack_utilization=0.3)
+    fleet = make_fleet(gpt, autoscaler=auto)
+    stub = fleet.slo
+    rng = np.random.default_rng(1)
+    reqs = requests(rng, 8)
+    # requests IN FLIGHT while the fleet scales up...
+    for r in reqs[:4]:
+        fleet.submit(r)
+    for _ in range(3):
+        stub.burn()
+        fleet.step()
+    assert len(fleet.replicas) == 2
+    for r in reqs[4:]:
+        fleet.submit(r)
+    stub.clear()
+    # ...and while it scales back down (the drain migrates live
+    # requests onto the survivor, token streams intact)
+    while fleet.has_work():
+        fleet.step()
+    for _ in range(12):
+        fleet.step()
+    assert fleet.stats.scale_downs == 1
+    assert len(fleet.replicas) == 1
+    assert fleet.stats.replicas_total == 1
+    # the removed replica's metric source is gone, the survivor's stays
+    assert "replica1" not in fleet.metrics.names()
+    assert "replica0" in fleet.metrics.names()
+    # zero lost, zero duplicated tokens across BOTH scale events
+    assert fleet.stats.failed == 0
+    for r in reqs:
+        assert r.status == "finished"
+        np.testing.assert_array_equal(
+            r.output(),
+            generate(fwd, r.prompt[None],
+                     max_new_tokens=r.max_new_tokens,
+                     context_length=64)[0],
+        )
+
+
+def test_autoscaler_rides_scenario_player(gpt):
+    """The tentpole composition: a workload-plane scenario driving a
+    fleet whose autoscaler mutates it mid-trace, verdicts recorded."""
+    auto = FleetAutoscaler(min_replicas=1, max_replicas=2, up_streak=2,
+                           down_streak=400, cooldown_ticks=4,
+                           chip_budget=8)
+    fleet = make_fleet(gpt, autoscaler=auto)
+    stub = fleet.slo
+    scenario = Scenario(
+        name="mini_ramp", seed=2,
+        phases=(
+            Phase(name="load", ticks=10, arrival_rate=1.0,
+                  prompt_len=Dist.uniform(4, 12),
+                  new_tokens=Dist.uniform(2, 4)),
+        ),
+    )
+    # burn from tick 2 on: the player's mid-trace ticks carry the
+    # scale-up
+    orig_step = fleet.step
+
+    def step():
+        if fleet.tick >= 2:
+            stub.burn()
+        orig_step()
+
+    fleet.step = step
+    report = ScenarioPlayer(scenario, fleet).play()
+    assert fleet.stats.scale_ups >= 1
+    assert len(report.finished) == len(report.admitted) \
+        == len(report.verdicts)
+    assert report.digest == scenario.digest()
+
+
+def test_scale_down_fleet_refusal_is_counted_not_raised():
+    """A fleet-side ValueError during the remove (e.g. the victim
+    became the last healthy replica between pick and drain) must land
+    in scale_rejected, never crash the serving loop."""
+    from types import SimpleNamespace
+
+    def rep(name):
+        return SimpleNamespace(
+            name=name, state="healthy", pending_removal=False,
+            engine=SimpleNamespace(
+                running_requests=[],
+                stats=SimpleNamespace(queue_depth=0)),
+        )
+
+    def refuse(name):
+        raise ValueError("cannot remove: last healthy replica")
+
+    fleet = SimpleNamespace(
+        tick=10,
+        stats=SimpleNamespace(scale_rejected=0, scale_downs=0),
+        chip_capacity=lambda: 8, chips_in_use=lambda: 2,
+        remove_replica=refuse,
+        replicas=[rep("r0"), rep("r1")],
+    )
+    auto = FleetAutoscaler(min_replicas=1, max_replicas=4)
+    out = auto._try_scale_down(fleet, list(fleet.replicas))
+    assert out == "scale_rejected"
+    assert fleet.stats.scale_rejected == 1
+    assert fleet.stats.scale_downs == 0
+    assert auto.events[-1]["kind"] == "scale_rejected"
+
+
+def test_autoscaler_constructor_validation():
+    with pytest.raises(ValueError):
+        FleetAutoscaler(min_replicas=0)
+    with pytest.raises(ValueError):
+        FleetAutoscaler(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        FleetAutoscaler(up_streak=0)
+    with pytest.raises(ValueError):
+        FleetAutoscaler(slack_utilization=1.5)
+    with pytest.raises(ValueError):
+        FleetAutoscaler(replica_chips=0)

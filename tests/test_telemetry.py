@@ -804,6 +804,47 @@ def test_slo_monitor_burn_rates_alerts_and_registry_source():
         SloTarget(name="r", metric="m", threshold=0.0, kind="rate")
 
 
+def test_slo_monitor_firing_and_quiet_streaks():
+    """The sustained-burn/slack surface the fleet autoscaler consumes:
+    consecutive burning evaluations count up, one quiet evaluation
+    resets them (and vice versa) — a streak, not a blip."""
+    from skycomputing_tpu.telemetry import (
+        MetricsTimeseries,
+        SloMonitor,
+        SloTarget,
+    )
+
+    state = {"v": 0.0}
+    registry = MetricsRegistry()
+    registry.register("s", lambda: dict(v=state["v"]),
+                      types={"v": "gauge"})
+    clock = FakeClock()
+    ts = MetricsTimeseries(registry, window=32, clock=clock)
+    monitor = SloMonitor([
+        SloTarget(name="lvl", metric="s.v", threshold=1.0,
+                  budget=1.0, fast_window=1, slow_window=1),
+    ], ts)
+
+    def tick(v):
+        clock.t += 1.0
+        state["v"] = v
+        ts.sample()
+        monitor.evaluate()
+
+    for _ in range(3):
+        tick(0.0)
+    assert monitor.firing_streak == 0 and monitor.quiet_streak == 3
+    for _ in range(4):
+        tick(5.0)
+    assert monitor.firing_streak == 4 and monitor.quiet_streak == 0
+    tick(0.0)
+    assert monitor.firing_streak == 0 and monitor.quiet_streak == 1
+    snap = monitor.snapshot()
+    assert snap["firing_streak"] == 0 and snap["quiet_streak"] == 1
+    # classified for the exporter/time-series like every other field
+    assert SloMonitor.FIELD_TYPES["firing_streak"] == "gauge"
+
+
 def test_request_timeline_from_serving_trace(tmp_path):
     """A single-engine serving trace reconstructs per request: the
     queue_wait -> prefill -> decode waterfall with one id, replica

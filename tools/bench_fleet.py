@@ -175,6 +175,7 @@ def run_bench(out: Optional[str], seed: int, ttft_factor: float,
         gpt_layer_configs,
     )
     from skycomputing_tpu.serving import Request
+    from skycomputing_tpu.workload import mixes
 
     cfg = GptConfig(vocab_size=512, hidden_size=64,
                     num_hidden_layers=2, num_attention_heads=2,
@@ -187,14 +188,11 @@ def run_bench(out: Optional[str], seed: int, ttft_factor: float,
     params = stack.init(jax.random.key(seed),
                         np.ones((1, 8), np.int32))
     fwd = jax.jit(lambda ids: stack.apply(params, ids))
+    # the request mixes come from the workload plane by NAME
+    # (fleet_bursty / fleet_spike); their draw order is byte-compatible
+    # with the inline make_request loops this bench used to carry, so
+    # the committed artifact's workload replays exactly at equal seed
     rng = np.random.default_rng(seed)
-
-    def make_request(max_new_lo=16, max_new_hi=28):
-        plen = int(rng.integers(8, 60))
-        return Request(
-            prompt=rng.integers(1, 500, (plen,)).astype(np.int32),
-            max_new_tokens=int(rng.integers(max_new_lo, max_new_hi)),
-        )
 
     from skycomputing_tpu.telemetry.slo import SloMonitor, SloTarget
 
@@ -266,8 +264,10 @@ def run_bench(out: Optional[str], seed: int, ttft_factor: float,
         seed=seed,
     ))
     arrivals = [
-        (tick0 + burst_gap * (i // burst), make_request())
-        for i in range(n_steady)
+        (tick, Request(prompt=prompt, max_new_tokens=n))
+        for tick, (prompt, n) in mixes.fleet_bursty_arrivals(
+            rng, n=n_steady, burst=burst, gap=burst_gap, start=tick0,
+        )
     ]
     steady_log: list = []  # (request, arrival_tick, decision)
     i = 0
@@ -294,7 +294,10 @@ def run_bench(out: Optional[str], seed: int, ttft_factor: float,
 
     # --- phase C: 2x arrival rate against the bounded admission
     rejected_before = dict(fleet.stats.rejected_by_reason)
-    spike_requests = [make_request() for _ in range(32)]
+    spike_requests = [
+        Request(prompt=prompt, max_new_tokens=n)
+        for prompt, n in mixes.fleet_spike_specs(rng, n=32)
+    ]
     spike_decisions = []
     j = 0
     spike0 = fleet.tick
