@@ -34,6 +34,7 @@ from .dynamics import (
     Worker,
     WorkerManager,
 )
+from .chaos import FaultInjector, FaultPlan, get_fault_plan
 from .fleet import FleetAutoscaler, FleetSupervisor, Router, ServingFleet
 from .parallel import MeshPipelineModel, PipelineModel, StageRuntime
 from .runner import AutotuneHook, Hook, Runner
@@ -106,6 +107,9 @@ __all__ = [
     "Scenario",
     "ScenarioPlayer",
     "get_scenario",
+    "FaultInjector",
+    "FaultPlan",
+    "get_fault_plan",
     "ServingAutotuner",
     "TuningAdvisor",
     "Stimulator",

@@ -193,6 +193,13 @@ MANIFEST: Dict[str, Any] = {
         # is pure stdlib (below)
         "workload": {"modules": ["skycomputing_tpu.workload"],
                      "may_import": ["serving"]},
+        # the chaos plane sits ABOVE the fleet (injector actuates
+        # replica/engine/admission hooks) but its plan core is pure
+        # stdlib (below); the plan_check edge is lazy (in-function)
+        # so analysis never appears here
+        "chaos": {"modules": ["skycomputing_tpu.chaos"],
+                  "may_import": ["fleet", "serving", "telemetry",
+                                 "utils"]},
         "tools": {"modules": ["tools"], "may_import": ["*"]},
     },
     # stdlib-only by contract: loadable by FILE PATH on a bare runner
@@ -201,6 +208,10 @@ MANIFEST: Dict[str, Any] = {
     "pure_stdlib": [
         "skycomputing_tpu.analysis.audit",
         "skycomputing_tpu.analysis.lint",
+        # the fault-plan core + named catalog (same contract as the
+        # scenario core: tools/chaos_smoke.py file-path-loads it on a
+        # bare runner; injector/invariants live outside this contract)
+        "skycomputing_tpu.chaos.plan",
         # the partition/mesh-shape solver: pure math by contract, so
         # tools/mesh_smoke.py can file-path-load it on a bare lint runner
         "skycomputing_tpu.dynamics.solver",
@@ -223,11 +234,15 @@ MANIFEST: Dict[str, Any] = {
     # imports live in try/except fallbacks — guarded imports are exempt)
     "file_path_tools": [
         "tools.bench_autotune",
+        # chaos bench: --list works on a bare runner (file-path catalog
+        # fallback); the gated replay imports jax inside run_bench
+        "tools.bench_chaos",
         "tools.bench_fleet",
         # scenario bench: --list works on a bare runner (file-path
         # catalog fallback); the gated run imports jax inside run_bench
         "tools.bench_scenarios",
         "tools.changed",
+        "tools.chaos_smoke",
         "tools.chunk_smoke",
         # mesh-shape-search contracts (file-path-loads dynamics/solver);
         # its jax section self-SKIPs on bare runners

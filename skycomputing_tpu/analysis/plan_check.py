@@ -1046,6 +1046,146 @@ def verify_scale_payload(scale: Any) -> List[str]:
     return problems
 
 
+#: the chaos plane's sanctioned fault vocabulary, duplicated BY VALUE
+#: from ``chaos.plan.FAULT_KINDS`` (the SCALE_ADD idiom: the verifier
+#: must not import the layer it verifies; tests pin the two in sync)
+FAULT_KINDS = (
+    "replica_crash",
+    "stage_slowdown",
+    "swap_corruption",
+    "reform_failure",
+    "admission_blip",
+)
+
+
+def verify_fault_plan(plan: Any) -> List[str]:
+    """Problems with a chaos fault plan (empty = valid).
+
+    Schema — what :meth:`~..chaos.plan.FaultPlan.to_dict` emits and
+    :class:`~..chaos.injector.FaultInjector` re-verifies before its
+    first event fires (verify-then-apply: a malformed plan dies before
+    any fleet mutation): ``name`` / ``scenario`` non-empty strings,
+    ``seed`` an int, ``replicas`` and ``recovery_budget_ticks``
+    positive ints, ``rate_scale`` / ``ticks_scale`` positive finite
+    numbers, and a non-empty ``events`` list where each event carries a
+    non-negative ``tick``, a ``kind`` from the sanctioned vocabulary, a
+    ``target`` selector consistent with its kind (``admission_blip``
+    must target ``fleet``; every other kind must NOT), a positive
+    ``duration``, and kind-consistent ``params`` (``stage_slowdown``
+    needs ``seconds > 0``, ``reform_failure`` needs ``builds >= 1``).
+    """
+    problems: List[str] = []
+    if not isinstance(plan, dict):
+        return [
+            f"fault plan must be an object, got {type(plan).__name__}"
+        ]
+    for key in ("name", "scenario"):
+        v = plan.get(key)
+        if not isinstance(v, str) or not v:
+            problems.append(
+                f"plan.{key} must be a non-empty string, got {v!r}"
+            )
+    seed = plan.get("seed")
+    if isinstance(seed, bool) or not isinstance(seed, int):
+        problems.append(f"plan.seed must be an int, got {seed!r}")
+    for key in ("replicas", "recovery_budget_ticks"):
+        v = plan.get(key)
+        if not _pos_int(v):
+            problems.append(
+                f"plan.{key} must be a positive int, got {v!r}"
+            )
+    for key in ("rate_scale", "ticks_scale"):
+        v = plan.get(key)
+        if v is None:
+            continue
+        if (isinstance(v, bool) or not isinstance(v, (int, float))
+                or not math.isfinite(float(v)) or float(v) <= 0):
+            problems.append(
+                f"plan.{key} must be a positive finite number, got "
+                f"{v!r}"
+            )
+    events = plan.get("events")
+    if not isinstance(events, list) or not events:
+        problems.append(
+            f"plan.events must be a non-empty list, got "
+            f"{type(events).__name__ if events is not None else None!r}"
+        )
+        return problems
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            problems.append(
+                f"events[{i}] must be an object, got "
+                f"{type(ev).__name__}"
+            )
+            continue
+        tick = ev.get("tick")
+        if isinstance(tick, bool) or not isinstance(tick, int) \
+                or tick < 0:
+            problems.append(
+                f"events[{i}].tick must be a non-negative int, got "
+                f"{tick!r}"
+            )
+        kind = ev.get("kind")
+        if kind not in FAULT_KINDS:
+            problems.append(
+                f"events[{i}].kind {kind!r} is not a sanctioned fault "
+                f"kind {list(FAULT_KINDS)}"
+            )
+            continue
+        target = ev.get("target")
+        if not isinstance(target, str) or not target:
+            problems.append(
+                f"events[{i}].target must be a non-empty selector, "
+                f"got {target!r}"
+            )
+        elif kind == "admission_blip" and target != "fleet":
+            problems.append(
+                f"events[{i}]: admission_blip must target 'fleet', "
+                f"got {target!r}"
+            )
+        elif kind != "admission_blip" and target == "fleet":
+            problems.append(
+                f"events[{i}]: {kind} needs a replica selector, got "
+                f"'fleet'"
+            )
+        duration = ev.get("duration", 1)
+        if not _pos_int(duration):
+            problems.append(
+                f"events[{i}].duration must be a positive int, got "
+                f"{duration!r}"
+            )
+        jitter = ev.get("jitter_ticks", 0)
+        if isinstance(jitter, bool) or not isinstance(jitter, int) \
+                or jitter < 0:
+            problems.append(
+                f"events[{i}].jitter_ticks must be a non-negative "
+                f"int, got {jitter!r}"
+            )
+        params = ev.get("params", {})
+        if not isinstance(params, dict):
+            problems.append(
+                f"events[{i}].params must be an object, got "
+                f"{type(params).__name__}"
+            )
+            continue
+        if kind == "stage_slowdown":
+            seconds = params.get("seconds")
+            if (isinstance(seconds, bool)
+                    or not isinstance(seconds, (int, float))
+                    or seconds <= 0):
+                problems.append(
+                    f"events[{i}]: stage_slowdown needs params."
+                    f"seconds > 0, got {seconds!r}"
+                )
+        elif kind == "reform_failure":
+            if not _pos_int(params.get("builds")):
+                problems.append(
+                    f"events[{i}]: reform_failure needs params."
+                    f"builds >= 1, got {params.get('builds')!r}"
+                )
+    return problems
+
+
 def _verify_serving_payload(serving: Any) -> List[str]:
     """Problems with a payload's optional ``serving`` operating point.
 
@@ -1281,6 +1421,7 @@ __all__ = [
     "PlanReport",
     "has_plan",
     "verify_allocation_payload",
+    "verify_fault_plan",
     "verify_mesh_payload",
     "verify_scale_payload",
     "verify_pipeline",

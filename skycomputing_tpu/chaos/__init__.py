@@ -1,0 +1,73 @@
+"""The chaos plane: seeded, replayable fault campaigns + auditing.
+
+- :mod:`.plan` — the PURE-STDLIB core: :class:`FaultEvent` /
+  :class:`FaultPlan` declare a fault campaign; one seeded
+  ``random.Random`` lowers jitter to a byte-reproducible event
+  schedule, and the named catalog (``replica_crash_storm``,
+  ``rolling_stragglers``, ``mid_drain_kill``, ``swap_corruption``,
+  ``reform_flap``, ``overload_then_crash``) gives every campaign a
+  stable ``--plan`` name (``tools/chaos_smoke.py`` file-path-loads
+  this on a bare runner);
+- :mod:`.injector` — :class:`FaultInjector` fires a plan's events at
+  exact fleet ticks through sanctioned hooks only, with an honest,
+  replayable event log;
+- :mod:`.invariants` — the whole-run auditor: token conservation,
+  reasoned terminal states, token identity against a fault-free
+  reference, page/refcount consistency, counter monotonicity, and the
+  gated recovery budget.
+
+The heavy halves (injector/invariants import the fleet stack) load
+lazily so the stdlib core stays importable anywhere.
+"""
+
+from __future__ import annotations
+
+from .plan import (
+    ADMISSION_BLIP,
+    FAULT_KINDS,
+    FAULT_PLANS,
+    FaultEvent,
+    FaultPlan,
+    REFORM_FAILURE,
+    REPLICA_CRASH,
+    STAGE_SLOWDOWN,
+    SWAP_CORRUPTION,
+    fault_plan_names,
+    get_fault_plan,
+    register_fault_plan,
+)
+
+try:  # fleet-backed halves; absent on bare stdlib-only runners
+    from .injector import FaultInjector
+    from .invariants import (
+        AuditCheck,
+        AuditReport,
+        audit_run,
+        fleet_settled,
+        make_probe,
+    )
+except ImportError:  # pragma: no cover - exercised on bare runners
+    FaultInjector = None  # type: ignore[assignment]
+    AuditCheck = AuditReport = None  # type: ignore[assignment]
+    audit_run = fleet_settled = make_probe = None  # type: ignore
+
+__all__ = [
+    "ADMISSION_BLIP",
+    "AuditCheck",
+    "AuditReport",
+    "FAULT_KINDS",
+    "FAULT_PLANS",
+    "FaultEvent",
+    "FaultInjector",
+    "FaultPlan",
+    "REFORM_FAILURE",
+    "REPLICA_CRASH",
+    "STAGE_SLOWDOWN",
+    "SWAP_CORRUPTION",
+    "audit_run",
+    "fault_plan_names",
+    "fleet_settled",
+    "get_fault_plan",
+    "make_probe",
+    "register_fault_plan",
+]

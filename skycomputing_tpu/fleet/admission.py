@@ -46,6 +46,7 @@ SHED_LOW_PRIORITY = "shed_low_priority"
 DEADLINE_UNMEETABLE = "deadline_unmeetable"
 NO_HEALTHY_REPLICA = "no_healthy_replica"
 REPLICAS_SATURATED = "replicas_saturated"
+ADMISSION_BLIP = "admission_blip"
 
 
 @dataclass
@@ -109,6 +110,13 @@ class AdmissionController:
         # monitor only moves the bound, visibly (detail carries it).
         self.slo_monitor = slo_monitor
         self.slo_tighten = float(slo_tighten)
+        # the sanctioned chaos hook (the chaos plane's admission_blip
+        # kind): while set, every decision rejects with the
+        # ADMISSION_BLIP reason — a transient front-door outage that
+        # stays VISIBLE (reasoned verdict + per-reason counter), never
+        # a silent drop.  The injector owns setting/clearing it at
+        # exact ticks; the decision itself stays pure.
+        self.blip_active = False
 
     # --- sizing -------------------------------------------------------------
     def _slo_burning(self) -> bool:
@@ -177,6 +185,15 @@ class AdmissionController:
                 f"unknown priority {priority!r}; known: "
                 f"{sorted(_PRIORITY_RANK)}"
             )
+        if self.blip_active:
+            # the injected front-door outage gates FIRST: a blip means
+            # the intake itself is down, so no other evidence matters —
+            # callers get the standard Retry-After-style hint
+            return AdmitDecision(
+                False, reason=ADMISSION_BLIP,
+                retry_after_s=self._service_s(tpot_p50_s) * 5.0,
+                detail=dict(pending=pending),
+            )
         if capacity_slots <= 0:
             return AdmitDecision(
                 False, reason=NO_HEALTHY_REPLICA,
@@ -218,6 +235,7 @@ class AdmissionController:
 
 
 __all__ = [
+    "ADMISSION_BLIP",
     "AdmissionController",
     "AdmitDecision",
     "BATCH",

@@ -91,9 +91,18 @@ class FleetStats:
     scale_ups: int = 0
     scale_downs: int = 0
     scale_rejected: int = 0
+    # chaos-plane accounting: faults the injector actually applied
+    # (skipped targets never count) and fault bursts the fleet fully
+    # recovered from — the recovery arc's terminal counter
+    faults_injected: int = 0
+    recoveries_completed: int = 0
     # gauges (last step)
     replicas_healthy: int = 0
     replicas_total: int = 0
+    #: replicas RETIRED out of rotation (re-form budget exhausted):
+    #: permanently lost capacity an operator must see as a number, not
+    #: infer from replicas_total minus replicas_healthy
+    replicas_quarantined: int = 0
     pending: int = 0
     #: queued-but-unserved backlog (replica queues + limbo, running
     #: excluded) — the overload gauge SLO targets should burn on:
@@ -122,7 +131,10 @@ class FleetStats:
         "ticks": "counter",
         "scale_ups": "counter", "scale_downs": "counter",
         "scale_rejected": "counter",
+        "faults_injected": "counter",
+        "recoveries_completed": "counter",
         "replicas_healthy": "gauge", "replicas_total": "gauge",
+        "replicas_quarantined": "gauge",
         "pending": "gauge", "queue_depth": "gauge",
         "limbo_depth": "gauge",
         "ttft_p50_s": "gauge", "ttft_p95_s": "gauge",
@@ -145,8 +157,11 @@ class FleetStats:
             scale_ups=self.scale_ups,
             scale_downs=self.scale_downs,
             scale_rejected=self.scale_rejected,
+            faults_injected=self.faults_injected,
+            recoveries_completed=self.recoveries_completed,
             replicas_healthy=self.replicas_healthy,
             replicas_total=self.replicas_total,
+            replicas_quarantined=self.replicas_quarantined,
             pending=self.pending,
             queue_depth=self.queue_depth,
             limbo_depth=self.limbo_depth,
@@ -339,6 +354,12 @@ class ServingFleet(LiveMetricsMixin):
             tick=self.tick,
             healthy=healthy,
             replicas=states,
+            # the supervisor's quarantine ledger: WHO is permanently
+            # out, when, and why — not just a shrinking healthy count
+            quarantined={
+                name: dict(entry)
+                for name, entry in self.supervisor.quarantined.items()
+            },
             pending=len(self._pending),
             limbo=len(self._limbo),
             slo_firing=list(self.slo.firing) if self.slo else [],
@@ -429,12 +450,16 @@ class ServingFleet(LiveMetricsMixin):
         return replica
 
     def remove_replica(self, name: str) -> str:
-        """Drain-then-remove scale-down; returns ``"removed"`` when the
-        replica left immediately or ``"draining"`` when it is finishing
-        requests that could not migrate (the supervisor finalizes the
-        removal once the drain empties).  Token streams survive exactly
-        as they do a sick-replica heal: graceful preempt, forced
-        redispatch onto survivors."""
+        """Drain-then-remove scale-down; always returns ``"draining"``:
+        the replica parks DRAINING (out of rotation, requests migrated)
+        and the supervisor finalizes the removal on its next poll —
+        once any requests that could not migrate finish.  Two-phase by
+        design: every removal has a real DRAINING window, so a replica
+        dying mid-removal always exercises the same hardened
+        ``finish_removal(dead=True)`` path instead of racing an inline
+        finalize.  Token streams survive exactly as they do a
+        sick-replica heal: graceful preempt, forced redispatch onto
+        survivors."""
         replica = self._by_name.get(name)
         if replica is None:
             raise ValueError(f"unknown replica {name!r}")
@@ -453,10 +478,7 @@ class ServingFleet(LiveMetricsMixin):
         replica.state = DRAINING
         self.router.forget_replica(name)
         self.redispatch(migrated)
-        if replica.engine.running_requests:
-            return "draining"
-        self.finalize_removal(replica)
-        return "removed"
+        return "draining"
 
     def finalize_removal(self, replica: EngineReplica) -> None:
         """Drop a fully-drained replica from the fleet (chips
@@ -750,6 +772,7 @@ class ServingFleet(LiveMetricsMixin):
 
     def _fail(self, request: Request, why: str) -> None:
         request.status = FAILED
+        request.fail_reason = why
         self._pending.pop(request.request_id, None)
         self._assignment.pop(request.request_id, None)
         self.stats.failed += 1
@@ -822,6 +845,9 @@ class ServingFleet(LiveMetricsMixin):
         self.stats.ticks += 1
         self.stats.replicas_healthy = len(self.healthy_replicas)
         self.stats.replicas_total = len(self.replicas)
+        self.stats.replicas_quarantined = sum(
+            1 for r in self.replicas if r.state == RETIRED
+        )
         self.stats.pending = len(self._pending)
         self.stats.queue_depth = self._pending_depth()
         self.stats.limbo_depth = len(self._limbo)

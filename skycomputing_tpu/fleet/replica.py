@@ -80,12 +80,14 @@ class EngineReplica:
         # each heal.  Prior generations' cumulative counters accumulate
         # here; stats_snapshot() adds them back.
         self._carried: Dict[str, float] = {}
-        # fault surface (written by FleetFaultInjector)
+        # fault surface (written by FleetFaultInjector and the chaos
+        # plane's FaultInjector)
         self.crashed = False
         self._stall_s = 0.0
         self._stall_clear_tick: Optional[int] = None
         self.leaked_slots: List[int] = []
         self._pending_leaks = 0
+        self._build_failures = 0
         # heartbeat ledger: beats are successful ticks; the supervisor
         # reads (and resets) consecutive misses
         self.beats = 0
@@ -206,6 +208,17 @@ class EngineReplica:
         self._pending_leaks += max(count, 0) - leaked
         return leaked
 
+    def fail_next_builds(self, count: int) -> None:
+        """Force the next ``count`` :meth:`rebuild` calls to fail (the
+        ``reform_failure`` chaos kind: an infeasible re-allocation, an
+        OOMing builder — any rebuild the pre-flight would reject).
+
+        The failure fires BEFORE the builder runs, so the rollback
+        contract holds exactly as for a real builder failure: nothing
+        is mutated, the supervisor's ``max_reforms`` budget is spent,
+        and the backoff clock starts."""
+        self._build_failures = max(int(count), 0)
+
     def _leak_now(self, count: int) -> int:
         leaked = 0
         for _ in range(count):
@@ -222,6 +235,15 @@ class EngineReplica:
         that made the original (worker-manager pre-flight included) and
         only then swap it in — a failed build leaves the old state
         untouched for the supervisor's rollback accounting."""
+        if self._build_failures > 0:
+            # the injected reform_failure: spend one charge and die
+            # exactly where a real builder rejection would, before any
+            # state is touched
+            self._build_failures -= 1
+            raise RuntimeError(
+                f"injected build failure on replica {self.name} "
+                f"({self._build_failures} more pending)"
+            )
         engine = self._build()
         # bank the dying generation's cumulative counters BEFORE the
         # swap (the stats object is still readable even for a crashed

@@ -42,7 +42,7 @@ to the fleet lane.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from ..telemetry import get_tracer
 from ..utils import Logger
@@ -93,6 +93,9 @@ class FleetSupervisor:
         heartbeat_misses: int = 2,
         check_every: int = 2,
         max_reforms: int = 2,
+        reform_backoff_base: int = 2,
+        reform_backoff_cap: int = 32,
+        clock: Optional[Callable[[], float]] = None,
         logger: Optional[Logger] = None,
         slo_monitor=None,
     ):
@@ -102,6 +105,11 @@ class FleetSupervisor:
             )
         if baseline_ticks < 1:
             raise ValueError("baseline_ticks must be >= 1")
+        if reform_backoff_base < 0 or reform_backoff_cap < 0:
+            raise ValueError(
+                "reform_backoff_base and reform_backoff_cap must be "
+                ">= 0"
+            )
         self._alpha = float(ewma_alpha)
         self._sick_threshold = float(sick_threshold)
         self._k_checks = int(k_checks)
@@ -110,6 +118,24 @@ class FleetSupervisor:
         self.heartbeat_misses = int(heartbeat_misses)
         self.check_every = int(check_every)
         self.max_reforms = int(max_reforms)
+        # exponential backoff between STANDALONE re-form retries (the
+        # poll()-driven path for replicas stranded DEAD/EVICTED or
+        # finishing a drain): a failed attempt schedules the next one
+        # base * 2^(failures-1) ticks out, capped — without this the
+        # supervisor hammers a rejecting builder every single poll.
+        # heal()'s inline attempt is deliberately NOT gated: fresh
+        # detection evidence earns an immediate try.  The clock is
+        # injectable (tests drive it deterministically); default is the
+        # fleet's own tick counter.
+        self.reform_backoff_base = int(reform_backoff_base)
+        self.reform_backoff_cap = int(reform_backoff_cap)
+        self._clock = clock
+        self._next_retry_at: Dict[str, float] = {}
+        # quarantine ledger: replicas RETIRED out of the fleet (re-form
+        # budget exhausted), kept visible — /healthz and FleetStats
+        # surface them so an operator sees WHAT is permanently out and
+        # WHY, instead of inferring it from a shrinking replica count
+        self.quarantined: Dict[str, Dict[str, Any]] = {}
         self._logger = logger or Logger()
         # optional online-SLO signal (duck-typed like the admission
         # controller's): while any declared SLO burns, the sick-check
@@ -167,6 +193,22 @@ class FleetSupervisor:
         without bound."""
         self._health.pop(name, None)
         self._reform_attempts.pop(name, None)
+        self._next_retry_at.pop(name, None)
+        self.quarantined.pop(name, None)
+
+    def _now(self, fleet) -> float:
+        """The backoff clock: injected when the caller wants control
+        (tests), the fleet's tick counter otherwise — both monotonic,
+        both in 'ticks' units for the default config."""
+        if self._clock is not None:
+            return float(self._clock())
+        return float(fleet.tick)
+
+    def _retry_gated(self, fleet, replica: EngineReplica) -> bool:
+        """True while the replica's backoff window is still open."""
+        return self._now(fleet) < self._next_retry_at.get(
+            replica.name, 0.0
+        )
 
     # --- detection ----------------------------------------------------------
     def _diagnose(self, replica: EngineReplica) -> Optional[str]:
@@ -218,13 +260,13 @@ class FleetSupervisor:
                 elif not replica.engine.running_requests:
                     if replica.pending_removal:
                         self.finish_removal(fleet, replica, dead=False)
-                    else:
+                    elif not self._retry_gated(fleet, replica):
                         self.retry_reform(fleet, replica)
             elif replica.state in (DEAD, EVICTED):
                 if replica.pending_removal:
                     self.finish_removal(fleet, replica,
                                         dead=replica.state == DEAD)
-                else:
+                elif not self._retry_gated(fleet, replica):
                     self.retry_reform(fleet, replica)
 
     # --- recovery -----------------------------------------------------------
@@ -357,14 +399,27 @@ class FleetSupervisor:
                              dict({"outcome": outcome}, **detail))
         return outcome
 
+    def _quarantine(self, fleet, replica: EngineReplica,
+                    attempts: int) -> None:
+        """Retire a replica whose re-form budget is exhausted and
+        ledger it: quarantined replicas stay in ``fleet.replicas``
+        (visible capacity loss) but are permanently out of rotation,
+        and the ledger entry says when and why."""
+        replica.state = RETIRED
+        self._next_retry_at.pop(replica.name, None)
+        self.quarantined[replica.name] = dict(
+            tick=fleet.tick, attempts=int(attempts),
+            reason="reform_budget_exhausted",
+        )
+        self._record("retired", replica, fleet.tick,
+                     attempts=int(attempts))
+
     def _attempt_reform(self, fleet, replica: EngineReplica, tracer,
                         lane) -> tuple:
         """One budgeted rebuild; (outcome, trace-arg detail)."""
         attempts = self._reform_attempts.get(replica.name, 0)
         if attempts >= self.max_reforms:
-            replica.state = RETIRED
-            self._record("retired", replica, fleet.tick,
-                         attempts=attempts)
+            self._quarantine(fleet, replica, attempts)
             return RETIRED_OUT, {}
         self._reform_attempts[replica.name] = attempts + 1
         try:
@@ -380,12 +435,25 @@ class FleetSupervisor:
             # re-form: the rollback is structural — nothing was mutated
             # — and the budget decides whether the replica retires now
             fleet.stats.reform_failures += 1
-            retired = self._reform_attempts[replica.name] >= \
-                self.max_reforms
+            failures = self._reform_attempts[replica.name]
+            retired = failures >= self.max_reforms
+            backoff = 0.0
             if retired:
-                replica.state = RETIRED
+                self._quarantine(fleet, replica, failures)
+            elif self.reform_backoff_base > 0:
+                # exponential: base, 2*base, 4*base ... capped — the
+                # NEXT standalone retry waits this long (heal()'s
+                # inline attempt on fresh detection is never gated)
+                backoff = float(min(
+                    self.reform_backoff_cap,
+                    self.reform_backoff_base * 2 ** (failures - 1),
+                ))
+                self._next_retry_at[replica.name] = (
+                    self._now(fleet) + backoff
+                )
             self._record(REFORM_FAILED, replica, fleet.tick,
-                         error=str(exc), retired=retired)
+                         error=str(exc), retired=retired,
+                         backoff=backoff)
             self._logger.warning(
                 f"FleetSupervisor: re-form of {replica.name} rejected "
                 f"({exc}); serving on survivors"
@@ -397,6 +465,7 @@ class FleetSupervisor:
         # must not monotonically retire replicas it keeps proving it
         # can heal
         self._reform_attempts[replica.name] = 0
+        self._next_retry_at.pop(replica.name, None)
         self.reset_era(replica)
         fleet.stats.reforms += 1
         self._record(REFORMED, replica, fleet.tick,

@@ -85,6 +85,12 @@ class Request:
     # and preemption resets it — recomputation replays the whole tail).
     prefilled_len: int = 0
 
+    # the reasoned verdict for a FAILED terminal state (set by whoever
+    # fails the request — fleet migration, swap-corruption fallback):
+    # "every request terminal with a reason" is an auditable invariant
+    # only if the reason rides on the request itself
+    fail_reason: Optional[str] = None
+
     # SLO stamps (perf_counter seconds; None until reached)
     submitted_s: Optional[float] = None
     first_token_s: Optional[float] = None

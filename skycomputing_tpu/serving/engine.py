@@ -53,6 +53,7 @@ batch_occupancy`` makes it visible instead of hidden.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import time
 from dataclasses import dataclass, field
@@ -80,6 +81,7 @@ from ..parallel.pipeline import (
 from ..telemetry import LiveMetricsMixin, MetricsRegistry, get_tracer
 from .batcher import (
     AdmissionQueue,
+    FAILED,
     FINISHED,
     QueueFullError,
     REJECTED,
@@ -171,6 +173,11 @@ class ServingStats:
     cow_copies: int = 0
     swap_outs: int = 0
     swap_ins: int = 0
+    # swap records whose swap-in checksum verification failed: the
+    # record is dropped and the victim resumes by recompute-from-prompt
+    # instead of restoring poisoned KV — corruption is only acceptable
+    # when it is caught, counted, and survived
+    swap_corruptions: int = 0
     prefix_evictions: int = 0
     # chunked-prefill accounting (prefill_chunk set): prefill_chunks
     # counts chunk rows computed (one request-chunk each);
@@ -229,7 +236,8 @@ class ServingStats:
         "decode_s": "counter",
         "prefix_hits": "counter", "prefix_tokens_reused": "counter",
         "cow_copies": "counter", "swap_outs": "counter",
-        "swap_ins": "counter", "prefix_evictions": "counter",
+        "swap_ins": "counter", "swap_corruptions": "counter",
+        "prefix_evictions": "counter",
         "prefill_chunks": "counter", "chunk_stalls": "counter",
         "quantized_pages": "counter", "dequant_blocks": "counter",
         "draft_tokens": "counter",
@@ -275,6 +283,7 @@ class ServingStats:
             cow_copies=self.cow_copies,
             swap_outs=self.swap_outs,
             swap_ins=self.swap_ins,
+            swap_corruptions=self.swap_corruptions,
             prefix_evictions=self.prefix_evictions,
             prefill_chunks=self.prefill_chunks,
             chunk_stalls=self.chunk_stalls,
@@ -562,6 +571,34 @@ class _PagedServingStage:
             (s(k, hk), s(v, hv))
             for (k, v), (hk, hv) in zip(self.slabs, host_pairs)
         ]
+
+
+def _swap_record_checksum(pages: int, index: int,
+                          data: List[Any]) -> str:
+    """sha256 over a swap record's host payload (page count, resume
+    index, and every host array byte — int8 records hash their scale
+    rows alongside the values, since a page restored under the wrong
+    scale dequantizes garbage just as surely as flipped value bits).
+    Stamped at swap-out, verified at swap-in: the integrity half of
+    the host-pool preemption path."""
+    h = hashlib.sha256()
+    h.update(f"{int(pages)}:{int(index)}".encode())
+
+    def fold(host) -> None:
+        # data nests: stages -> per-layer (k, v) pairs -> arrays or
+        # QuantizedPages (values + scale) — recurse to the leaves
+        if isinstance(host, QuantizedPages):
+            fold(host.values)
+            fold(host.scale)
+            return
+        if isinstance(host, (list, tuple)):
+            for item in host:
+                fold(item)
+            return
+        h.update(np.ascontiguousarray(host).tobytes())
+
+    fold(data)
+    return h.hexdigest()
 
 
 class ServingEngine(LiveMetricsMixin):
@@ -1345,9 +1382,15 @@ class ServingEngine(LiveMetricsMixin):
             )
             held = self._pool.table(request_id)
             table[: len(held)] = held
+            data = [st.swap_out(table) for st in self.stages]
             swap_record = dict(
-                pages=len(held), index=request.index,
-                data=[st.swap_out(table) for st in self.stages],
+                pages=len(held), index=request.index, data=data,
+                # integrity stamp, verified at swap-in: a record
+                # corrupted while parked on the host must fall back to
+                # recompute, never restore poisoned KV
+                checksum=_swap_record_checksum(
+                    len(held), request.index, data
+                ),
             )
         if prefilling:
             self._prefilling.pop(request_id)
@@ -1425,6 +1468,63 @@ class ServingEngine(LiveMetricsMixin):
                 self._trace_close_queue(r, tracer, drained=True)
         self.stats.queue_depth = 0
         return drained
+
+    def corrupt_swap_record(self, request_id: Optional[int] = None,
+                            *, force: bool = False) -> Optional[int]:
+        """Flip bits in a held swap record's host payload (the
+        sanctioned ``swap_corruption`` chaos hook — host-pool rot,
+        a DMA gone wrong — applied through the record surface, never
+        by monkeypatching).
+
+        Targets ``request_id``'s record when given, else the oldest
+        held record.  With ``force`` and nothing parked, the oldest
+        running request is swapped out first through the public
+        ``preempt`` path (so there is always a record to poison).
+        Returns the corrupted record's request id, or None when no
+        record exists and none can be forced — the injector logs that
+        honestly instead of inventing a fault that never happened."""
+        if not self._paged:
+            raise ValueError(
+                "swap records exist on paged engines only"
+            )
+        if request_id is not None:
+            if request_id not in self._swapped:
+                raise KeyError(
+                    f"request {request_id} holds no swap record"
+                )
+            rid = request_id
+        elif self._swapped:
+            rid = min(self._swapped)
+        else:
+            rid = None
+            if force:
+                # oldest running request first: smallest id = the
+                # record most likely to be swapped back in soon
+                for cand in sorted(self._running):
+                    try:
+                        self.preempt(cand, mode="swap")
+                    except (ValueError, KeyError):
+                        continue
+                    rid = cand
+                    break
+            if rid is None:
+                return None
+        record = self._swapped[rid]
+        pairs = record["data"][0]
+        k_host, v_host = pairs[0]
+        leaf = k_host.values if isinstance(k_host, QuantizedPages) \
+            else k_host
+        raw = bytearray(np.ascontiguousarray(leaf).tobytes())
+        raw[0] ^= 0xFF
+        bad = np.frombuffer(bytes(raw), dtype=leaf.dtype).reshape(
+            leaf.shape
+        )
+        if isinstance(k_host, QuantizedPages):
+            k_host = QuantizedPages(bad, k_host.scale)
+        else:
+            k_host = bad
+        pairs[0] = (k_host, v_host)
+        return rid
 
     @property
     def running_requests(self) -> List[Request]:
@@ -2278,8 +2378,14 @@ class ServingEngine(LiveMetricsMixin):
             head = queued[0]
             if head.request_id in self._swapped:
                 if not self._swap_in(head):
-                    self._stall_on_pages()
-                    return
+                    if head.request_id in self._swapped:
+                        # pages genuinely unavailable: the head stalls
+                        # the queue until a release frees them
+                        self._stall_on_pages()
+                        return
+                    # corrupt record dropped (or the victim FAILED):
+                    # re-judge the head as a normal recompute admission
+                    continue
                 continue
             if self._chunk_policy is not None:
                 # chunked admission is charge-only (no compute): the
@@ -2761,8 +2867,45 @@ class ServingEngine(LiveMetricsMixin):
         """Re-seat a swapped-out request: fresh pages, host copies
         scattered back, NO prefill — decoding continues from exactly
         where the swap-out left it.  False (nothing mutated) when the
-        pages cannot be charged yet."""
+        pages cannot be charged yet.
+
+        Integrity gate FIRST: the record's swap-out checksum is
+        re-computed over the host payload before any state is touched.
+        A mismatch means the parked KV is poisoned — the record is
+        dropped (``swap_corruptions`` counts it) and the request falls
+        back to the recompute-from-prompt path (also returning False,
+        with the record gone, so the admission loop re-judges the head
+        as a normal recompute re-admission).  A victim whose resume
+        prefix has outgrown every bucket cannot recompute either; it
+        is FAILED with a reasoned verdict instead of served garbage."""
         record = self._swapped[request.request_id]
+        expect = record.get("checksum")
+        if expect is not None and _swap_record_checksum(
+                record["pages"], record["index"],
+                record["data"]) != expect:
+            del self._swapped[request.request_id]
+            self.stats.swap_corruptions += 1
+            tracer = get_tracer()
+            if tracer is not None:
+                tracer.instant(
+                    "swap_corrupt", tracer.lane("serving", "engine"),
+                    {"request": request.request_id,
+                     "pages": record["pages"]},
+                )
+            resume_len = int(request.effective_prompt.size)
+            try:
+                self.bucketer.bucket_for(resume_len)
+            except ValueError:
+                # structurally unservable: swap was the ONLY way this
+                # resume prefix could return, and its record is gone
+                self._queue.remove(request)
+                request.status = FAILED
+                request.fail_reason = (
+                    "swap record corrupted and the resume prefix fits "
+                    "no bucket"
+                )
+                self.stats.queue_depth = self._queue.depth
+            return False
         pages = self._pool.acquire_pages(
             request.request_id, record["pages"]
         )

@@ -1,0 +1,636 @@
+"""Chaos-plane contracts (CPU-deterministic, tier-1).
+
+The chaos plane makes fault campaigns values: a seeded
+:class:`FaultPlan` declares WHAT goes wrong and WHEN, the
+:class:`FaultInjector` fires it at a live fleet through sanctioned
+hooks only, and the whole-run auditor (:func:`audit_run`) proves the
+fleet's promises survived — zero lost or duplicated tokens, reasoned
+terminal states, page/refcount consistency, monotonic counters, and a
+gated time-to-healthy.  This suite pins the pure-stdlib plan core
+(validation, seeded jitter, digests, the named catalog, the
+plan_check schema twin), the injector's honest event log, the
+supervisor's re-form backoff + quarantine ledger, swap-record
+integrity on a real paged engine, and the composed scenarios the
+ISSUE names: a mid-drain kill under scale-down, a re-form failure
+storm to quarantine under load, and double-run determinism.
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+import jax
+
+from skycomputing_tpu.analysis.plan_check import (
+    FAULT_KINDS as PLAN_CHECK_FAULT_KINDS,
+    verify_fault_plan,
+)
+from skycomputing_tpu.builder import build_layer_stack
+from skycomputing_tpu.chaos import (
+    ADMISSION_BLIP,
+    FAULT_KINDS,
+    FaultEvent,
+    FaultInjector,
+    FaultPlan,
+    REFORM_FAILURE,
+    REPLICA_CRASH,
+    STAGE_SLOWDOWN,
+    SWAP_CORRUPTION,
+    audit_run,
+    fault_plan_names,
+    fleet_settled,
+    get_fault_plan,
+    make_probe,
+)
+from skycomputing_tpu.fleet import FleetSupervisor, ServingFleet
+from skycomputing_tpu.fleet.replica import (
+    DRAINING,
+    HEALTHY,
+    RETIRED,
+)
+from skycomputing_tpu.fleet.supervisor import REFORM_FAILED
+from skycomputing_tpu.models.gpt import (
+    GptConfig,
+    generate,
+    gpt_layer_configs,
+)
+from skycomputing_tpu.serving import Request, ServingEngine
+from skycomputing_tpu.serving.batcher import FAILED, FINISHED
+from skycomputing_tpu.workload import ScenarioPlayer, get_scenario
+from skycomputing_tpu.workload.scenario import scenario_names
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    """Tiny GPT + host params + jitted one-shot forward reference (the
+    test_fleet fixture shape, so stage programs share the in-process
+    compile cache across suites)."""
+    cfg = GptConfig(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=2, max_position_embeddings=64,
+                    dropout_prob=0.0, dtype="float32")
+    layer_cfgs = gpt_layer_configs(cfg, deterministic=True)
+    stack = build_layer_stack(layer_cfgs)
+    params = stack.init(jax.random.key(7), np.ones((1, 5), np.int32))
+    fwd = jax.jit(lambda ids: stack.apply(params, ids))
+    return layer_cfgs, params, fwd
+
+
+def reference(fwd, request):
+    out = generate(fwd, request.prompt[None],
+                   max_new_tokens=request.max_new_tokens,
+                   context_length=64)
+    return out[0]
+
+
+def mixed_requests(rng, specs):
+    return [
+        Request(prompt=rng.integers(1, 512, (l,)).astype(np.int32),
+                max_new_tokens=n)
+        for l, n in specs
+    ]
+
+
+def fast_supervisor(**kw):
+    defaults = dict(check_every=1, heartbeat_misses=1, grace_ticks=2,
+                    baseline_ticks=3, k_checks=2, sick_threshold=3.0)
+    defaults.update(kw)
+    return FleetSupervisor(**defaults)
+
+
+def make_fleet(gpt, replicas=2, supervisor=None, **engine_kw):
+    layer_cfgs, params, _ = gpt
+    base = dict(num_slots=3, max_len=64, buckets=(8, 16))
+    base.update(engine_kw)
+    return ServingFleet(
+        layer_cfgs, params, replicas=replicas, engine_kwargs=base,
+        supervisor=supervisor or fast_supervisor(),
+    )
+
+
+def drain(fleet, max_ticks=400):
+    for _ in range(max_ticks):
+        if not fleet.has_work():
+            return
+        fleet.step()
+    raise AssertionError("fleet did not drain")
+
+
+# --------------------------------------------------------------------------
+# the plan core: pure stdlib, no fleet needed
+# --------------------------------------------------------------------------
+
+
+def crash_plan(events, **kw):
+    base = dict(name="t", seed=0, scenario="tenant_mix",
+                recovery_budget_ticks=30)
+    base.update(kw)
+    return FaultPlan(events=tuple(events), **base)
+
+
+def test_event_validation_rejects_malformed():
+    """Malformed events and plans die at build time with a reason —
+    never mid-replay (the Dist-factory idiom)."""
+    bad = [
+        # events
+        lambda: FaultEvent(tick=-1, kind=REPLICA_CRASH),
+        lambda: FaultEvent(tick=0, kind=REPLICA_CRASH, duration=0),
+        lambda: FaultEvent(tick=0, kind=REPLICA_CRASH,
+                           jitter_ticks=-1),
+        lambda: FaultEvent(tick=0, kind="meteor_strike"),
+        lambda: FaultEvent(tick=0, kind=REPLICA_CRASH,
+                           target="fleet"),
+        lambda: FaultEvent(tick=0, kind=ADMISSION_BLIP,
+                           target="index:0"),
+        lambda: FaultEvent(tick=0, kind=REPLICA_CRASH,
+                           target="index:x"),
+        lambda: FaultEvent(tick=0, kind=REPLICA_CRASH,
+                           target="rack:3"),
+        lambda: FaultEvent(tick=0, kind=STAGE_SLOWDOWN),
+        lambda: FaultEvent(tick=0, kind=REFORM_FAILURE,
+                           params=(("builds", 0),)),
+        lambda: FaultEvent(tick=0, kind=REPLICA_CRASH,
+                           params=(("seconds", 1),)),
+        lambda: FaultEvent(tick=0, kind=SWAP_CORRUPTION,
+                           params=(("force", "yes"),)),
+        # plans
+        lambda: crash_plan([], name="empty"),
+        lambda: crash_plan([FaultEvent(tick=0, kind=REPLICA_CRASH)],
+                           name=""),
+        lambda: crash_plan([FaultEvent(tick=0, kind=REPLICA_CRASH)],
+                           scenario=""),
+        lambda: crash_plan([FaultEvent(tick=0, kind=REPLICA_CRASH)],
+                           recovery_budget_ticks=0),
+        lambda: crash_plan([FaultEvent(tick=0, kind=REPLICA_CRASH)],
+                           replicas=0),
+        lambda: crash_plan([FaultEvent(tick=0, kind=REPLICA_CRASH)],
+                           rate_scale=0.0),
+    ]
+    for build in bad:
+        with pytest.raises(ValueError):
+            build()
+
+
+def test_jitter_lowering_is_seeded_and_bounded():
+    """One rng drawn in declaration order: resolved schedules are
+    byte-identical across calls, every jittered tick lands inside its
+    declared window, and unjittered events pass through untouched."""
+    plan = crash_plan([
+        FaultEvent(tick=10, kind=REPLICA_CRASH, jitter_ticks=3),
+        FaultEvent(tick=20, kind=REPLICA_CRASH, target="index:1"),
+        FaultEvent(tick=1, kind=REPLICA_CRASH, jitter_ticks=4),
+    ], seed=11)
+    a = plan.resolved_events()
+    b = plan.resolved_events()
+    assert [e.key() for e in a] == [e.key() for e in b]
+    assert 7 <= a[0].tick <= 13
+    assert a[1].tick == 20
+    assert 0 <= a[2].tick <= 5  # clamped at 0, never negative
+    assert all(e.jitter_ticks == 0 for e in a)
+    # a different seed is a different schedule for SOME seed pair
+    moved = [plan.with_seed(s).resolved_events()[0].tick
+             for s in range(8)]
+    assert len(set(moved)) > 1
+    assert plan.last_declared_tick == 20
+
+
+def test_digest_scopes_identity_seed_and_schedule():
+    """Same plan -> same digest; a new seed or a moved event is a new
+    campaign even when no jitter is in play."""
+    plan = crash_plan([FaultEvent(tick=5, kind=REPLICA_CRASH)])
+    assert plan.digest() == plan.digest()
+    assert plan.with_seed(1).digest() != plan.digest()
+    moved = crash_plan([FaultEvent(tick=6, kind=REPLICA_CRASH)])
+    assert moved.digest() != plan.digest()
+
+
+def test_catalog_names_pairing_and_replay():
+    """The six documented campaigns, in order, each paired with a REAL
+    workload-catalog scenario, each byte-replayable; unknown names fail
+    with the catalog in the message."""
+    assert fault_plan_names() == [
+        "replica_crash_storm", "rolling_stragglers", "mid_drain_kill",
+        "swap_corruption", "reform_flap", "overload_then_crash",
+    ]
+    for name in fault_plan_names():
+        plan = get_fault_plan(name, seed=3)
+        assert plan.name == name and plan.seed == 3
+        assert plan.scenario in scenario_names()
+        assert plan.recovery_budget_ticks >= 1
+        assert plan.digest() == get_fault_plan(name, seed=3).digest()
+    with pytest.raises(ValueError, match="unknown fault plan"):
+        get_fault_plan("meteor_strike")
+    # the root package re-exports the chaos vocabulary
+    import skycomputing_tpu as sky
+    assert sky.FaultPlan is FaultPlan
+    assert sky.get_fault_plan is get_fault_plan
+
+
+def test_fault_kinds_pinned_to_plan_check_twin():
+    """analysis/plan_check.py duplicates FAULT_KINDS by value (the
+    layering contract forbids the import); this pin is what keeps the
+    two tuples in sync."""
+    assert tuple(PLAN_CHECK_FAULT_KINDS) == tuple(FAULT_KINDS)
+
+
+def test_verify_fault_plan_schema_negatives():
+    """The injector's verify-then-apply gate: a catalog plan's dict is
+    clean, and every class of corruption is named."""
+    base = get_fault_plan("reform_flap").to_dict()
+    assert verify_fault_plan(base) == []
+
+    def corrupt(mutate):
+        doc = copy.deepcopy(base)
+        mutate(doc)
+        return verify_fault_plan(doc)
+
+    assert corrupt(lambda d: d["events"][0].update(kind="meteor"))
+    assert corrupt(lambda d: d["events"][0].update(tick=-2))
+    assert corrupt(lambda d: d["events"][0].update(target=""))
+    assert corrupt(lambda d: d["events"][0]["params"].pop("builds"))
+    assert corrupt(lambda d: d.update(events=[]))
+    assert corrupt(lambda d: d.update(seed="zero"))
+    assert corrupt(lambda d: d.update(rate_scale=0))
+    assert corrupt(lambda d: d.update(recovery_budget_ticks=0))
+    # admission_blip <-> fleet selector consistency, both directions
+    blip = copy.deepcopy(base)
+    blip["events"][0] = dict(tick=1, kind="admission_blip",
+                             target="index:0", params={}, duration=2,
+                             jitter_ticks=0)
+    assert verify_fault_plan(blip)
+    non_blip_fleet = copy.deepcopy(base)
+    non_blip_fleet["events"][1].update(target="fleet")
+    assert verify_fault_plan(non_blip_fleet)
+    assert verify_fault_plan("not a dict")
+
+
+# --------------------------------------------------------------------------
+# the injector: exact ticks, sanctioned hooks, honest log
+# --------------------------------------------------------------------------
+
+
+def test_injector_fires_exact_ticks_and_logs_skips(gpt):
+    """Events land at their declared fleet ticks through the public
+    fault surfaces; a selector that resolves to nothing is LOGGED as a
+    skip (ok=False) instead of silently vanishing; applied faults
+    count FleetStats.faults_injected and recovery arcs close when the
+    fleet settles."""
+    layer_cfgs, params, fwd = gpt
+    plan = crash_plan([
+        FaultEvent(tick=2, kind=REPLICA_CRASH, target="index:0"),
+        FaultEvent(tick=3, kind=REPLICA_CRASH, target="index:9"),
+        FaultEvent(tick=4, kind=ADMISSION_BLIP, target="fleet",
+                   duration=2),
+        FaultEvent(tick=6, kind=STAGE_SLOWDOWN, target="index:1",
+                   params=(("seconds", 0.003),), duration=1),
+    ])
+    fleet = make_fleet(gpt)
+    fleet.fault_injector = FaultInjector(plan)
+    rng = np.random.default_rng(4)
+    requests = mixed_requests(rng, [(5, 12), (3, 10)])
+    for r in requests:
+        fleet.submit(r)
+    for _ in range(8):
+        fleet.step()
+    # the blip lifted exactly duration ticks after firing
+    assert fleet.admission.blip_active is False
+    drain(fleet)
+    for _ in range(6):  # settle: let the last recovery arc close
+        fleet.step()
+
+    log = fleet.fault_injector.event_log()
+    assert [(e["tick"], e["kind"], e["ok"]) for e in log] == [
+        (2, REPLICA_CRASH, True),
+        (3, REPLICA_CRASH, False),
+        (4, ADMISSION_BLIP, True),
+        (6, STAGE_SLOWDOWN, True),
+    ]
+    assert log[1]["note"] == "index 9 out of range"
+    assert log[2]["resolved"] == "fleet"
+    assert fleet.stats.faults_injected == 3
+    # the determinism projection drops only the load-sensitive field
+    det = fleet.fault_injector.deterministic_log()
+    assert all("resolved" not in e for e in det)
+    assert [e["tick"] for e in det] == [e["tick"] for e in log]
+    # the crash healed: zero lost tokens, and the fleet settled within
+    # closed recovery arcs
+    for r in requests:
+        assert r.status == FINISHED
+        np.testing.assert_array_equal(r.output(), reference(fwd, r))
+    assert fleet_settled(fleet)
+    assert fleet.fault_injector.recoveries
+    assert (fleet.stats.recoveries_completed
+            == len(fleet.fault_injector.recoveries))
+    assert all(rec["settled_tick"] >= rec["fault_tick"]
+               for rec in fleet.fault_injector.recoveries)
+
+
+def test_injector_refuses_unverified_plan(gpt):
+    """Verify-then-apply: the injector re-checks the plan through the
+    analysis schema at its FIRST on_tick and dies before any mutation
+    when the value drifted — e.g. a duck-typed stand-in that never
+    went through FaultPlan's build-time validation."""
+
+    class DriftedPlan:
+        name = "drifted"
+        recovery_budget_ticks = 10
+
+        def resolved_events(self):
+            return []
+
+        def to_dict(self):
+            return {"name": "drifted"}  # no scenario, no events, ...
+
+    fleet = make_fleet(gpt)
+    fleet.fault_injector = FaultInjector(DriftedPlan())
+    with pytest.raises(ValueError, match="failed verification"):
+        fleet.step()
+    assert fleet.stats.faults_injected == 0
+
+
+# --------------------------------------------------------------------------
+# supervisor: exponential re-form backoff + quarantine
+# --------------------------------------------------------------------------
+
+
+def test_reform_backoff_is_exponential_under_injected_clock(gpt):
+    """A failed standalone re-form schedules the next retry base *
+    2^(failures-1) ticks out (capped); the window is enforced against
+    the injectable clock, and a success refunds both the budget and
+    the backoff.  heal()'s inline attempt on fresh detection is never
+    gated."""
+    clock = [0.0]
+    sup = fast_supervisor(max_reforms=3, reform_backoff_base=4,
+                          reform_backoff_cap=8,
+                          clock=lambda: clock[0])
+    fleet = make_fleet(gpt, supervisor=sup)
+    victim = fleet.replicas[0]
+    victim.fail_next_builds(2)
+    victim.crash()
+    fleet.step()  # detection + the ungated inline attempt: failure 1
+    assert fleet.stats.reform_failures == 1
+    for _ in range(3):  # clock frozen: the window gates every poll
+        fleet.step()
+    assert fleet.stats.reform_failures == 1
+    assert victim.state != HEALTHY
+    clock[0] = 4.0  # window open: retry 2 fails, backoff doubles
+    fleet.step()
+    assert fleet.stats.reform_failures == 2
+    clock[0] = 11.0  # 4 + min(cap=8, 4*2) = 12: still gated
+    fleet.step()
+    assert fleet.stats.reform_failures == 2
+    clock[0] = 12.0  # open again: the third attempt succeeds
+    fleet.step()
+    assert victim.state == HEALTHY
+    assert fleet.stats.reforms == 1
+    failures = [e for e in sup.events if e["kind"] == REFORM_FAILED]
+    assert [e["backoff"] for e in failures] == [4.0, 8.0]
+    assert not failures[-1]["retired"]
+    # success refunded the ledger: no retry gate, no spent budget
+    assert sup._reform_attempts[victim.name] == 0
+    assert victim.name not in sup._next_retry_at
+
+
+def test_quarantine_is_surfaced_in_healthz_and_stats(gpt):
+    """max_reforms consecutive failures retire the replica into the
+    quarantine ledger — visible in /healthz and the
+    replicas_quarantined gauge, with when and why — while the fleet
+    keeps serving on survivors."""
+    layer_cfgs, params, fwd = gpt
+    sup = fast_supervisor(max_reforms=2, reform_backoff_base=0)
+    fleet = make_fleet(gpt, supervisor=sup)
+    victim = fleet.replicas[0]
+    victim.fail_next_builds(10)
+    victim.crash()
+    for _ in range(4):
+        fleet.step()
+    assert victim.state == RETIRED
+    entry = sup.quarantined[victim.name]
+    assert entry["reason"] == "reform_budget_exhausted"
+    assert entry["attempts"] == 2
+    health = fleet._health_snapshot()
+    assert health["quarantined"][victim.name]["reason"] \
+        == "reform_budget_exhausted"
+    assert health["status"] == "degraded"
+    assert fleet.stats.replicas_quarantined == 1
+    assert fleet.stats.snapshot()["replicas_quarantined"] == 1
+    # retired is a terminal, SETTLED state: the fleet serves on
+    rng = np.random.default_rng(9)
+    request = mixed_requests(rng, [(6, 7)])[0]
+    outputs = fleet.run([request])
+    np.testing.assert_array_equal(
+        outputs[request.request_id], reference(fwd, request)
+    )
+    assert fleet_settled(fleet)
+
+
+# --------------------------------------------------------------------------
+# swap-record integrity on a real paged engine
+# --------------------------------------------------------------------------
+
+
+def test_swap_corruption_falls_back_to_recompute(gpt):
+    """A bit-flipped swap record is caught by the swap-out checksum at
+    swap-in: the record is dropped, swap_corruptions counts it, and
+    the victim resumes by recompute — token-identical."""
+    layer_cfgs, params, fwd = gpt
+    engine = ServingEngine(layer_cfgs, params, num_slots=2,
+                           max_len=48, buckets=(8, 16),
+                           kv_layout="paged", page_size=8)
+    rng = np.random.default_rng(17)
+    victim, bystander = mixed_requests(rng, [(5, 10), (7, 8)])
+    engine.submit(victim)
+    engine.submit(bystander)
+    while len(victim.tokens) < 2:
+        engine.step()
+    engine.preempt(victim.request_id, mode="swap")
+    assert engine.corrupt_swap_record(victim.request_id) \
+        == victim.request_id
+    engine.run()
+    assert engine.stats.swap_corruptions == 1
+    assert not engine._swapped  # the poisoned record is gone
+    for r in (victim, bystander):
+        assert r.status == FINISHED
+        np.testing.assert_array_equal(r.output(), reference(fwd, r))
+    engine._pool.check_consistency()
+
+
+def test_corrupt_swap_with_unservable_resume_fails_reasoned(gpt):
+    """When the corrupted record was the ONLY way back (the resume
+    prefix has outgrown every bucket, so recompute is structurally
+    impossible) the request is FAILED with a reasoned verdict — never
+    served garbage, never silently dropped."""
+    layer_cfgs, params, _ = gpt
+    engine = ServingEngine(layer_cfgs, params, num_slots=2,
+                           max_len=32, buckets=(8,),
+                           kv_layout="paged", page_size=8)
+    rng = np.random.default_rng(23)
+    doomed = mixed_requests(rng, [(6, 10)])[0]
+    engine.submit(doomed)
+    while len(doomed.tokens) < 4:  # resume prefix 6 + 4 > bucket 8
+        engine.step()
+    engine.preempt(doomed.request_id, mode="swap")
+    engine.corrupt_swap_record(doomed.request_id)
+    engine.run()
+    assert doomed.status == FAILED
+    assert doomed.fail_reason == (
+        "swap record corrupted and the resume prefix fits no bucket"
+    )
+    assert engine.stats.swap_corruptions == 1
+    engine._pool.check_consistency()
+
+
+# --------------------------------------------------------------------------
+# composed campaigns (the ISSUE's scenario satellites)
+# --------------------------------------------------------------------------
+
+
+def test_mid_drain_kill_exercises_hardened_removal(gpt):
+    """A replica dying mid-scale-down-drain with an active fault plan:
+    the armed pending_removal kill strikes the DRAINING window the
+    two-phase removal guarantees, the supervisor escalates to
+    finish_removal(dead=True), and every token stream survives."""
+    layer_cfgs, params, fwd = gpt
+    plan = crash_plan(
+        [FaultEvent(tick=1, kind=REPLICA_CRASH,
+                    target="pending_removal")],
+        name="kill_next_drain",
+    )
+    fleet = make_fleet(gpt, replicas=2)
+    fleet.fault_injector = FaultInjector(plan)
+    rng = np.random.default_rng(31)
+    requests = mixed_requests(rng, [(5, 12), (3, 10), (12, 9), (6, 11)])
+    for r in requests:
+        fleet.submit(r)
+    fleet.step()
+    fleet.step()  # tick 1 passed with no drain in flight: the kill ARMS
+    log = fleet.fault_injector.event_log()
+    assert len(log) == 1 and not log[0]["ok"]
+    assert log[0]["note"].endswith("; armed")
+
+    victim = fleet.replicas[1]
+    assert fleet.remove_replica(victim.name) == "draining"
+    # two-phase removal: a real DRAINING window, not an inline finalize
+    assert victim.state == DRAINING and victim.pending_removal
+    fleet.step()  # the armed kill fires, the supervisor finishes it dead
+    assert victim not in fleet.replicas
+    assert victim.state == RETIRED
+    removal = [e for e in fleet.supervisor.events
+               if e["kind"] == "removed"]
+    assert removal and removal[0]["dead"] is True
+    log = fleet.fault_injector.event_log()
+    assert [e["ok"] for e in log] == [False, True]
+    assert log[1]["resolved"] == victim.name
+    assert fleet.stats.faults_injected == 1
+
+    drain(fleet)
+    for r in requests:
+        assert r.status == FINISHED
+        np.testing.assert_array_equal(r.output(), reference(fwd, r))
+    audit = audit_run(fleet, _report_stub(fleet),
+                      injector=fleet.fault_injector)
+    page = next(c for c in audit.checks
+                if c.name == "page_consistency")
+    assert page.ok, page.detail
+
+
+def _report_stub(fleet):
+    """A minimal PlayerReport stand-in for audits of hand-driven (non-
+    player) runs: no verdicts, no timeline — the structural checks
+    (page consistency, recovery) still judge the live fleet."""
+    from skycomputing_tpu.workload.player import PlayerReport
+    return PlayerReport(scenario="manual", seed=0, digest="",
+                        ticks_run=fleet.tick)
+
+
+def test_reform_storm_quarantines_under_load(gpt):
+    """A re-form failure storm under live traffic: the victim burns
+    its whole max_reforms budget and lands in quarantine while the
+    fleet keeps serving — and the whole-run audit holds."""
+    layer_cfgs, params, _ = gpt
+    # flash_crowd: its 40+16-token worst case fits the 64-position
+    # test model (tenant_mix can emit 44+24 > max_pos)
+    plan = FaultPlan(
+        name="reform_storm", seed=0, scenario="flash_crowd",
+        rate_scale=0.5, ticks_scale=0.2, replicas=2,
+        recovery_budget_ticks=40,
+        events=(
+            FaultEvent(tick=2, kind=REFORM_FAILURE, target="index:1",
+                       params=(("builds", 6),)),
+            FaultEvent(tick=4, kind=REPLICA_CRASH, target="index:1"),
+        ),
+    )
+    # sick_threshold 8 (the bench_chaos setting): the planned faults
+    # must be the ONLY heals — a wall-clock hiccup reading as a
+    # straggler would add unplanned drains to the story
+    sup = fast_supervisor(max_reforms=2, reform_backoff_base=1,
+                          reform_backoff_cap=2, sick_threshold=8.0,
+                          k_checks=3)
+    fleet = ServingFleet(
+        layer_cfgs, params, replicas=plan.replicas,
+        engine_kwargs=dict(num_slots=2, max_len=64,
+                           buckets=(16, 32, 64)),
+        supervisor=sup,
+    )
+    injector = FaultInjector(plan)
+    fleet.fault_injector = injector
+    probe = make_probe(fleet)
+    scenario = get_scenario(plan.scenario, seed=plan.scenario_seed,
+                            rate_scale=plan.rate_scale,
+                            ticks_scale=plan.ticks_scale)
+    report = ScenarioPlayer(scenario, fleet, sample_fn=probe).play()
+    for _ in range(plan.recovery_budget_ticks + 5):
+        fleet.step()
+        report.timeline.append(probe())
+
+    retired = [r for r in fleet.replicas if r.state == RETIRED]
+    assert len(retired) == 1
+    assert sup.quarantined[retired[0].name]["reason"] \
+        == "reform_budget_exhausted"
+    assert fleet.stats.reform_failures == 2
+    assert report.summary()["total"]["finished"] > 0
+    audit = audit_run(fleet, report, injector=injector)
+    assert audit.ok, [c.to_dict() for c in audit.failures()]
+
+
+def test_double_run_determinism_same_seed_same_story(gpt):
+    """Two fresh fleets replaying the same catalog campaign at the
+    same seed produce a byte-identical deterministic event log and an
+    equal audit digest — chaos you can replay in a bug report."""
+    layer_cfgs, params, _ = gpt
+    plan = get_fault_plan("overload_then_crash")
+
+    def replay():
+        fleet = ServingFleet(
+            layer_cfgs, params, replicas=plan.replicas,
+            engine_kwargs=dict(num_slots=2, max_len=64,
+                               buckets=(16, 32, 64)),
+            # latency healing OFF in spirit (threshold far above any
+            # CPU jitter): both runs must tell the PLAN's story only
+            supervisor=fast_supervisor(sick_threshold=50.0,
+                                       k_checks=4),
+        )
+        injector = FaultInjector(plan)
+        fleet.fault_injector = injector
+        probe = make_probe(fleet)
+        scenario = get_scenario(plan.scenario,
+                                seed=plan.scenario_seed,
+                                rate_scale=plan.rate_scale,
+                                ticks_scale=plan.ticks_scale)
+        report = ScenarioPlayer(scenario, fleet,
+                                sample_fn=probe).play()
+        for _ in range(plan.recovery_budget_ticks + 5):
+            fleet.step()
+            report.timeline.append(probe())
+        return report, audit_run(fleet, report, injector=injector), \
+            injector
+
+    report_a, audit_a, inj_a = replay()
+    report_b, audit_b, inj_b = replay()
+    assert report_a.digest == report_b.digest  # same trace, first
+    assert any(e["ok"] for e in inj_a.event_log())
+    assert inj_a.deterministic_log() == inj_b.deterministic_log()
+    assert audit_a.digest() == audit_b.digest()
+    assert audit_a.ok, [c.to_dict() for c in audit_a.failures()]

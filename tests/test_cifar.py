@@ -1,6 +1,7 @@
 """CIFAR10Dataset: real binary-format parsing + synthetic fallback."""
 
 import numpy as np
+import pytest
 
 from skycomputing_tpu.dataset import CIFAR10Dataset
 
@@ -34,6 +35,7 @@ def test_synthetic_fallback():
     assert 0 <= label < 10
 
 
+@pytest.mark.slow  # re-tiered: tier-1 wall-clock budget; full run keeps it
 def test_trains_through_resnet_pipeline(devices, tmp_path):
     import jax
     import optax

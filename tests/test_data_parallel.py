@@ -32,6 +32,7 @@ def build(devices, n_workers=4, n_replicas=2, seed=0):
     return wm, ps, data, labels
 
 
+@pytest.mark.slow  # re-tiered: tier-1 wall-clock budget; full run keeps it
 def test_dp_update_equals_full_batch(devices):
     """R=2 averaged-grad update == single pipeline on the full batch
     (deterministic model, loss is a per-example mean)."""
@@ -50,6 +51,7 @@ def test_dp_update_equals_full_batch(devices):
                                        rtol=1e-5, atol=1e-7)
 
 
+@pytest.mark.slow  # re-tiered: tier-1 wall-clock budget; full run keeps it
 def test_replicas_stay_identical_over_steps(devices):
     wm, ps, data, labels = build(devices, seed=1)
     dp = DataParallelPipeline(wm, ps, optax.adam(1e-3), cross_entropy_loss,

@@ -2,6 +2,7 @@
 
 import jax
 import numpy as np
+import pytest
 from jax.sharding import Mesh
 
 from skycomputing_tpu.builder import build_layer, build_layer_stack
@@ -61,6 +62,7 @@ def test_single_device_flash_default_matches_einsum():
                                rtol=3e-5, atol=3e-6)
 
 
+@pytest.mark.slow  # re-tiered: tier-1 wall-clock budget; full run keeps it
 def test_long_bert_full_model_long_sequence(devices):
     """512-token stacked long-BERT classifier forward on the 8-device ring."""
     cfg = bert_config("tiny", dtype="float32", hidden_dropout_prob=0.0,
@@ -120,6 +122,7 @@ def test_ulysses_strategy_matches_ring(devices):
                                rtol=3e-5, atol=3e-6)
 
 
+@pytest.mark.slow  # re-tiered: tier-1 wall-clock budget; full run keeps it
 def test_long_bert_grads_flow(devices):
     cfg = bert_config("tiny", dtype="float32", hidden_dropout_prob=0.0,
                       attention_probs_dropout_prob=0.0,

@@ -69,6 +69,7 @@ def test_single_expert_equals_dense_mlp():
     np.testing.assert_allclose(out_moe, out_dense, rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow  # re-tiered: tier-1 wall-clock budget; full run keeps it
 def test_moe_gpt_trains_and_sows_aux_loss():
     cfg = _cfg()
     layer_cfgs = gpt_layer_configs(cfg, deterministic=True, moe_every=1,

@@ -10,6 +10,7 @@ from skycomputing_tpu.runner import CheckpointHook, Runner
 from tests.test_runner import _BatchAdapter, build_world
 
 
+@pytest.mark.slow  # re-tiered: tier-1 wall-clock budget; full run keeps it
 def test_exact_resume_matches_uninterrupted_run(devices, tmp_path):
     """Train 2 epochs straight vs 1 epoch + save + restore + 1 epoch:
     with Adam (stateful), identical final params require the optimizer

@@ -414,6 +414,35 @@ def test_straggler_triggers_one_heal_and_beats_no_heal_control(devices,
     assert t_healed < t_control, (t_healed, t_control)
 
 
+class _TransientStallClock:
+    """Deterministic iteration clock emulating ONE stalled iteration:
+    every iteration reads as ``tick_s`` except ``stall_iter``, which
+    reads ``tick_s + stall_s``.  The hook reads the clock exactly twice
+    per iteration (before_iter / after_iter, in order), so the end-read
+    advances by that iteration's cost.  Same rationale as
+    ``_EmulatedIterClock``: with ~30 ms real steps, host contention in
+    a loaded full-suite run inflated post-stall iterations past the
+    1.5x threshold and the real-clock EWMA healed on machine noise —
+    the k-window debounce under test never got a clean signal."""
+
+    def __init__(self, stall_iter: int, stall_s: float,
+                 tick_s: float = 0.05):
+        self._now = 0.0
+        self._reads = 0
+        self._stall_iter = stall_iter
+        self._stall_s = stall_s
+        self._tick_s = tick_s
+
+    def __call__(self) -> float:
+        it, end_read = divmod(self._reads, 2)
+        if end_read:
+            self._now += self._tick_s + (
+                self._stall_s if it == self._stall_iter else 0.0
+            )
+        self._reads += 1
+        return self._now
+
+
 def test_transient_stall_does_not_trigger_heal(devices):
     """A one-iteration wedge (fault kind 'stall') must not cause a
     re-allocation: the divergence is not sustained."""
@@ -421,7 +450,9 @@ def test_transient_stall_does_not_trigger_heal(devices):
     # iter 9: inside a DETECTION window (baseline learned over iters 2-7)
     plan = FaultPlan([dict(iter=9, kind="stall", seconds=0.4)])
     heal = SelfHealHook(alloc, window=3, k_windows=2, threshold=1.5,
-                        grace_iters=2, max_heals=1)
+                        grace_iters=2, max_heals=1,
+                        clock=_TransientStallClock(stall_iter=9,
+                                                   stall_s=0.4))
     runner = Runner(model, ps, wm, max_epochs=100, max_iters=18)
     runner.register_hook(FaultInjectionHook(plan))
     runner.register_hook(heal)

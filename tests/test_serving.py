@@ -737,6 +737,10 @@ def test_paged_attn_impl_pallas_identity_and_recompile_pin(gpt):
         )
 
 
+# re-tiered slow: tier-1 wall-clock budget; the full run keeps it, and
+# the int8 agreement/identity contract is additionally gated on every
+# BENCH_serving.json regeneration (kernel_quant section)
+@pytest.mark.slow
 def test_paged_int8_agreement_and_observability(gpt):
     """kv_dtype="int8": bounded-error pages keep greedy streams in high
     positional agreement with the fp engine (exactness is NOT the

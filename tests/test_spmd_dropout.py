@@ -81,6 +81,7 @@ def test_seeded_determinism_and_divergence(devices):
     assert a != c, "different keys must draw different masks"
 
 
+@pytest.mark.slow  # re-tiered: tier-1 wall-clock budget; full run keeps it
 def test_dropout_trajectory_diverges_but_trains(devices):
     mesh = make_pipeline_mesh(4, devices[:4])
     batch, labels = bert_data()
@@ -108,6 +109,7 @@ def test_dropout_trajectory_diverges_but_trains(devices):
     assert sto_losses[-1] < sto_losses[0], sto_losses
 
 
+@pytest.mark.slow  # re-tiered: tier-1 wall-clock budget; full run keeps it
 def test_dropout_through_interleaved_schedule(devices):
     """V=2 interleaved: per-tick keys follow the chunk wavefront."""
     mesh = make_pipeline_mesh(2, devices[:2])

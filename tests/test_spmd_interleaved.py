@@ -123,6 +123,7 @@ def test_interleaved_padded_non_multiple_m_matches_sequential(devices):
     )
 
 
+@pytest.mark.slow  # re-tiered: tier-1 wall-clock budget; full run keeps it
 def test_grouped_interleaved_m_gt_s_matches_sequential(devices):
     """M=8 > S=4 runs the grouped Megatron schedule; same math."""
     cfg = bert_config("tiny", dtype="float32", hidden_dropout_prob=0.0,

@@ -8,6 +8,7 @@ math untouched: step-for-step parity with the replicated-state pipeline.
 import jax
 import numpy as np
 import optax
+import pytest
 
 from skycomputing_tpu.models import bert_config
 from skycomputing_tpu.parallel import make_dp_pp_mesh
@@ -47,6 +48,7 @@ def test_zero1_shards_state_over_dp(devices):
             )
 
 
+@pytest.mark.slow  # re-tiered: tier-1 wall-clock budget; full run keeps it
 def test_zero1_matches_replicated_training(devices):
     pipe_r, params_r, opt_r, batch, labels = _world(devices, zero1=False)
     pipe_z, params_z, opt_z, _, _ = _world(devices, zero1=True)

@@ -52,6 +52,7 @@ def test_zero3_shards_params_over_dp(devices):
     )
 
 
+@pytest.mark.slow  # re-tiered: tier-1 wall-clock budget; full run keeps it
 def test_zero3_matches_replicated_training(devices):
     pipe_r, params_r, opt_r, batch, labels = _world(devices, zero3=False)
     pipe_z, params_z, opt_z, _, _ = _world(devices, zero3=True)

@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""CI smoke for the chaos plane's fault-plan core (pure stdlib).
+
+Loads ``chaos/plan.py`` by file path (the skylint idiom, so the lint
+job exercises it on a bare runner, no jax/numpy installed) and drives
+the replayability contract end to end: build-time validation of kinds,
+targets and params, the seeded jitter lowering, byte-identical resolved
+schedules at equal seed, divergent digests at different seeds, and
+every named catalog plan's structural promises (a paired workload
+scenario, a sane recovery budget, kind/target consistency).  Drift in
+any of these silently changes every committed chaos campaign — this
+smoke is what makes "same seed, same fault schedule, forever" a CI
+fact instead of a docstring.
+
+Usage::
+
+    python tools/chaos_smoke.py
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_by_path(name: str, *parts: str):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ROOT, *parts)
+    )
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[name] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+try:
+    from skycomputing_tpu.chaos import plan as _cp
+except Exception:  # pragma: no cover - exercised on bare CI runners
+    _cp = _load_by_path(
+        "_skytpu_chaos_smoke",
+        "skycomputing_tpu", "chaos", "plan.py",
+    )
+
+# the workload pairing must resolve against the scenario catalog, and
+# that catalog is itself pure stdlib — load it the same way
+try:
+    from skycomputing_tpu.workload import scenario as _wl
+except Exception:  # pragma: no cover - exercised on bare CI runners
+    _wl = _load_by_path(
+        "_skytpu_chaos_smoke_wl",
+        "skycomputing_tpu", "workload", "scenario.py",
+    )
+
+
+def check(cond, message):
+    if not cond:
+        print(f"FAIL: {message}")
+        raise SystemExit(1)
+    print(f"  ok: {message}")
+
+
+def main() -> int:
+    FaultEvent, FaultPlan = _cp.FaultEvent, _cp.FaultPlan
+
+    print("event validation:")
+    for bad in (
+        lambda: FaultEvent(tick=-1, kind=_cp.REPLICA_CRASH),
+        lambda: FaultEvent(tick=0, kind="meteor_strike"),
+        lambda: FaultEvent(tick=0, kind=_cp.REPLICA_CRASH,
+                           target="fleet"),
+        lambda: FaultEvent(tick=0, kind=_cp.ADMISSION_BLIP,
+                           target="index:0"),
+        lambda: FaultEvent(tick=0, kind=_cp.REPLICA_CRASH,
+                           target="index:nope"),
+        lambda: FaultEvent(tick=0, kind=_cp.STAGE_SLOWDOWN,
+                           params=(("seconds", -1.0),)),
+        lambda: FaultEvent(tick=0, kind=_cp.REFORM_FAILURE,
+                           params=(("builds", 0),)),
+        lambda: FaultEvent(tick=0, kind=_cp.REPLICA_CRASH,
+                           params=(("seconds", 1.0),)),
+    ):
+        try:
+            bad()
+        except ValueError:
+            pass
+        else:
+            check(False, "malformed events must raise at build time")
+    check(True, "malformed kinds/targets/params rejected at build "
+                "time")
+
+    print("jitter lowering:")
+    plan = FaultPlan(
+        name="smoke", seed=3, scenario="tenant_mix",
+        recovery_budget_ticks=10,
+        events=(
+            FaultEvent(tick=5, kind=_cp.REPLICA_CRASH,
+                       jitter_ticks=3),
+            FaultEvent(tick=9, kind=_cp.REPLICA_CRASH,
+                       target="index:1"),
+        ),
+    )
+    r1, r2 = plan.resolved_events(), plan.resolved_events()
+    check([e.key() for e in r1] == [e.key() for e in r2],
+          "same plan -> byte-identical resolved schedule")
+    check(2 <= r1[0].tick <= 8 and r1[0].jitter_ticks == 0,
+          "jitter stays within +/- jitter_ticks and lowers to 0")
+    check(r1[1].tick == 9,
+          "events without jitter keep their declared tick")
+    check(plan.digest() == plan.digest(), "digest is stable")
+    check(plan.digest() != plan.with_seed(4).digest(),
+          "a different seed is a different campaign")
+    check(plan.last_declared_tick == 9,
+          "last_declared_tick bounds the pre-jitter schedule")
+
+    print("catalog:")
+    names = _cp.fault_plan_names()
+    check(names == ["replica_crash_storm", "rolling_stragglers",
+                    "mid_drain_kill", "swap_corruption",
+                    "reform_flap", "overload_then_crash"],
+          f"the six named plans are registered ({names})")
+    scenario_names = set(_wl.scenario_names())
+    for name in names:
+        p = _cp.get_fault_plan(name)
+        check(p.name == name and p.events,
+              f"{name}: builds with events")
+        check(p.scenario in scenario_names,
+              f"{name}: pairs with catalog scenario {p.scenario!r}")
+        check(p.recovery_budget_ticks >= 1 and p.replicas >= 1,
+              f"{name}: recovery budget and fleet shape are sane")
+        check(p.digest() == _cp.get_fault_plan(name).digest(),
+              f"{name}: schedule replays byte-identically")
+        check(p.digest() != _cp.get_fault_plan(name, seed=1).digest(),
+              f"{name}: seed participates in the digest")
+        sc = _wl.get_scenario(p.scenario, seed=p.scenario_seed,
+                              rate_scale=p.rate_scale,
+                              ticks_scale=p.ticks_scale)
+        budget_end = p.last_declared_tick + p.recovery_budget_ticks
+        check(sc.total_ticks <= budget_end + 200,
+              f"{name}: paired trace ends near the campaign "
+              f"({sc.total_ticks} ticks vs last fault "
+              f"{p.last_declared_tick})")
+    try:
+        _cp.get_fault_plan("no_such_campaign")
+    except ValueError as exc:
+        check("catalog" in str(exc), "unknown name lists the catalog")
+    else:
+        check(False, "unknown plan name must raise")
+
+    print("chaos smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
