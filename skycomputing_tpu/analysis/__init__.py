@@ -24,6 +24,13 @@ into compile errors):
   import graph with cycle detection, the lock-discipline rule family
   SKY009-SKY011, and counter-type-drift checks over every
   ``FIELD_TYPES`` classification.  CLI: ``python -m tools.skyaudit``.
+- :mod:`.determinism` — **skydet**, the determinism & digest-integrity
+  pass: clock/seed discipline in the MANIFEST-declared deterministic
+  modules (DET001/DET002), digest-excluded-field and iteration-order
+  dataflow on digest paths (DET003/DET004), program-cache key
+  completeness for the serving/mesh program caches (DET005), and the
+  test-flakiness gate over ``tests/`` (DET006).  CLI:
+  ``python -m tools.skydet``.
 """
 
 from .audit import (
@@ -31,6 +38,12 @@ from .audit import (
     AuditConfig,
     RULES as AUDIT_RULES,
     audit_paths,
+)
+from .determinism import (
+    DetConfig,
+    RULES as DET_RULES,
+    check_paths,
+    check_pure_stdlib_loads,
 )
 from .lint import Finding, LintConfig, lint_file, lint_paths, RULES
 from .plan_check import (
@@ -50,6 +63,10 @@ __all__ = [
     "AUDIT_RULES",
     "AuditConfig",
     "audit_paths",
+    "DET_RULES",
+    "DetConfig",
+    "check_paths",
+    "check_pure_stdlib_loads",
     "Finding",
     "LintConfig",
     "lint_file",

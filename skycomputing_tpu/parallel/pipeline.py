@@ -322,7 +322,12 @@ class _StagePrograms:
                 for cfg in layer_cfgs
             ]
         )
-        self.optimizer = optimizer  # pinned: cache key uses id(optimizer)
+        # pinned: the cache key uses id(optimizer), which is only sound
+        # while this strong reference keeps the id from being recycled —
+        # declared in the skyaudit MANIFEST id_key_pins (skydet DET004)
+        # and regression-guarded by
+        # tests/test_determinism_lint.py::test_optimizer_id_key_is_pinned
+        self.optimizer = optimizer
         stack, eval_stack = self.stack, self.eval_stack
 
         def fwd(params, inputs, rng):

@@ -545,14 +545,19 @@ def test_shape_diagnostic_survives_empty_exception_message():
 
 
 def test_verifier_runs_abstractly_without_devices_warmup():
-    # well under the <1s launch-cost bar on the test instance, and
-    # repeat verification is near-free (module-global trace cache)
-    import time as _time
+    # repeat verification is near-free because the module-global trace
+    # cache is keyed PER LAYER (config canon + aval signature), not per
+    # allocation: re-verifying the same layers under a different split
+    # must be pure cache hits.  Asserted on the cache itself — the
+    # wall-clock-bound form of this test flaked under full-suite load
+    # (skydet DET006 now gates that form out of tests/)
+    from skycomputing_tpu.analysis.plan_check import _LAYER_TRACE_CACHE
 
     verify_plan(_model_cfg(), _wm([3, 3, 2]), (X,))
-    t0 = _time.perf_counter()
+    entries_after_first = len(_LAYER_TRACE_CACHE)
+    assert entries_after_first > 0
     verify_plan(_model_cfg(), _wm([2, 3, 3]), (X,))
-    assert _time.perf_counter() - t0 < 1.0
+    assert len(_LAYER_TRACE_CACHE) == entries_after_first
 
 
 # --------------------------------------------------------------------------

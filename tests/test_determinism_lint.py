@@ -1,0 +1,526 @@
+"""skydet: determinism & digest-integrity analysis (DET001-DET006).
+
+Per rule ID: one known-violation fixture that MUST fire and one clean
+fixture that MUST stay silent — the committed proof that each rule
+catches its bug family and is quiet on the sanctioned idioms (injected
+clocks, locally seeded rngs, sorted digest folds, measured-vs-measured
+test assertions).  Plus the self-gate pin (the whole tree passes
+``--strict`` with ZERO suppressions), the MANIFEST-exemption mechanics,
+the ``tools/_loader.py`` contract, and the keyed-lifetime regression
+test for the ``id(optimizer)`` program-cache pin.
+
+Carries the ``lint`` marker: part of the fast tier-1 lint gate.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from skycomputing_tpu.analysis.determinism import (
+    DetConfig,
+    RULES as DET_RULES,
+    check_paths,
+    check_pure_stdlib_loads,
+    check_source,
+    default_manifest,
+)
+
+pytestmark = pytest.mark.lint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------------------------
+# per-rule fixtures: bad MUST fire, clean MUST stay silent
+# --------------------------------------------------------------------------
+
+DET_FIXTURES = {
+    "DET001": dict(
+        path="plan.py", module="skycomputing_tpu.chaos.plan",
+        bad='''
+import time
+def resolve(events):
+    t0 = time.monotonic()
+    return [(t0, e) for e in events]
+''',
+        # the sanctioned idiom: the clock is a parameter DEFAULT (a bare
+        # reference, never a call) and only the injected callable is read
+        clean='''
+import time
+def resolve(events, clock=time.monotonic):
+    t0 = clock()
+    return [(t0, e) for e in events]
+''',
+    ),
+    "DET002": dict(
+        path="plan.py", module="skycomputing_tpu.chaos.plan",
+        bad='''
+import random
+def jitter(xs):
+    random.shuffle(xs)
+    return random.random()
+''',
+        clean='''
+import random
+def jitter(xs, seed):
+    rng = random.Random(seed)
+    rng.shuffle(xs)
+    return rng.random()
+''',
+    ),
+    "DET003": dict(
+        path="digests.py", module="digests",
+        bad='''
+import hashlib
+def trace_digest(records, stats):
+    h = hashlib.sha256()
+    for rec in records:
+        h.update(repr((rec.wall_s, rec.kind)).encode())
+    for key, value in stats.items():
+        h.update(repr((key, value)).encode())
+    return h.hexdigest()
+''',
+        clean='''
+import hashlib
+def trace_digest(records, stats):
+    h = hashlib.sha256()
+    for rec in records:
+        h.update(repr((rec.tick, rec.kind)).encode())
+    for key, value in sorted(stats.items()):
+        h.update(repr((key, value)).encode())
+    return h.hexdigest()
+''',
+    ),
+    "DET004": dict(
+        path="cache.py", module="cache",
+        bad='''
+def programs_for(cfgs, optimizer):
+    cache_key = (repr(cfgs), id(optimizer))
+    return cache_key
+''',
+        clean='''
+def programs_for(cfgs, optimizer):
+    cache_key = (repr(cfgs), optimizer.name)
+    return cache_key
+''',
+    ),
+    "DET005": dict(
+        path="programs.py", module="programs",
+        bad='''
+def get_programs(cfgs, mode):
+    key = repr(cfgs)
+    return cached_programs(key, lambda: build(cfgs, mode))
+''',
+        clean='''
+def get_programs(cfgs, mode):
+    key = (repr(cfgs), mode)
+    return cached_programs(key, lambda: build(cfgs, mode))
+''',
+    ),
+    "DET006": dict(
+        path="test_wall.py", module=None,
+        bad='''
+import time
+def test_fast_path():
+    t0 = time.perf_counter()
+    run()
+    assert time.perf_counter() - t0 < 1.0
+''',
+        # the sanctioned robust form: a measured/measured ratio untaints
+        clean='''
+import time
+def test_overhead():
+    t0 = time.perf_counter()
+    cost = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    step = time.perf_counter() - t1
+    assert cost / step < 0.01
+''',
+    ),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(DET_FIXTURES))
+def test_rule_fires_on_bad_and_stays_silent_on_clean(rule):
+    fx = DET_FIXTURES[rule]
+    bad = check_source(fx["bad"], fx["path"], module=fx["module"])
+    assert any(f.rule == rule for f in bad), (
+        f"{rule} must fire on its violation fixture; got "
+        + "\n".join(f.format() for f in bad)
+    )
+    clean = [f for f in check_source(fx["clean"], fx["path"],
+                                     module=fx["module"])
+             if f.rule == rule]
+    assert clean == [], "\n".join(f.format() for f in clean)
+
+
+def test_det000_on_unparseable_source():
+    findings = check_source("def broken(:\n", "oops.py")
+    assert [f.rule for f in findings] == ["DET000"]
+
+
+# --------------------------------------------------------------------------
+# rule mechanics beyond the basic pairs
+# --------------------------------------------------------------------------
+
+
+def test_det001_only_applies_to_declared_deterministic_modules():
+    src = DET_FIXTURES["DET001"]["bad"]
+    findings = check_source(src, "hooks.py",
+                            module="skycomputing_tpu.runner.hooks")
+    assert [f for f in findings if f.rule == "DET001"] == []
+
+
+def test_det002_one_rng_contract_flags_a_second_random():
+    src = '''
+import random
+def arrivals(seed):
+    rng = random.Random(seed)
+    rng2 = random.Random(seed + 1)
+    return rng.random() + rng2.random()
+'''
+    findings = check_source(
+        src, "scenario.py", module="skycomputing_tpu.workload.scenario")
+    dets = [f for f in findings if f.rule == "DET002"]
+    assert len(dets) == 1 and "ONE rng" in dets[0].message
+    one = src.replace("    rng2 = random.Random(seed + 1)\n", "")
+    one = one.replace(" + rng2.random()", "")
+    assert [f for f in check_source(
+        one, "scenario.py", module="skycomputing_tpu.workload.scenario")
+        if f.rule == "DET002"] == []
+
+
+def test_det003_declared_digest_path_functions_are_walked():
+    manifest = {
+        "digest_path_functions": ["Rec.key"],
+        "digest_excluded_fields": ["request_id"],
+    }
+    src = '''
+class Rec:
+    def key(self):
+        return (self.tick, self.request_id)
+'''
+    findings = check_source(src, "rec.py", manifest=manifest)
+    assert any(f.rule == "DET003" and "request_id" in f.message
+               for f in findings)
+    assert [f for f in check_source(src, "rec.py", manifest={})
+            if f.rule == "DET003"] == []
+
+
+def test_det004_manifest_pin_exempts_with_rationale():
+    src = DET_FIXTURES["DET004"]["bad"]
+    manifest = {"id_key_pins": {
+        "cache.programs_for": "object strong-referenced by the entry",
+    }}
+    findings = check_source(src, "cache.py", manifest=manifest,
+                            module="cache")
+    assert [f for f in findings if f.rule == "DET004"] == []
+
+
+def test_det005_guarded_constructor_pattern_end_to_end():
+    """The ``_STAGE_PROGRAMS`` shape: a cache-guarded constructor whose
+    stored closures capture a parameter the call site's key expression
+    never derives from — the exact serving/mesh hole."""
+    bad = '''
+_STAGE_PROGRAMS = {}
+
+class _Stage:
+    def __init__(self, modules, flavor, program_key):
+        self.modules = modules
+        cached = _STAGE_PROGRAMS.get(program_key)
+        if cached is not None:
+            self.step = cached
+            return
+        mods = self.modules
+
+        def step(x):
+            return run(mods, flavor, x)
+
+        self.step = step
+        _STAGE_PROGRAMS[program_key] = step
+
+
+class Engine:
+    def __init__(self, model_cfg, flavor):
+        self._cfg = list(model_cfg)
+        key = repr(self._cfg)
+        self.stage = _Stage(self._cfg, flavor, program_key=key)
+'''
+    manifest = {"program_caches": ["_STAGE_PROGRAMS"]}
+    findings = check_source(bad, "engine.py", manifest=manifest)
+    assert any(f.rule == "DET005" and "`flavor`" in f.message
+               for f in findings), "\n".join(f.format() for f in findings)
+    clean = bad.replace("key = repr(self._cfg)",
+                        "key = (repr(self._cfg), flavor)")
+    assert [f for f in check_source(clean, "engine.py", manifest=manifest)
+            if f.rule == "DET005"] == []
+
+
+def test_det006_sleep_flags_and_manifest_sanction_covers_subtree():
+    src = '''
+import time
+def test_real_watchdog():
+    def stalled():
+        time.sleep(0.3)
+    drive(stalled)
+'''
+    findings = check_source(src, "test_wd.py", manifest={})
+    assert any(f.rule == "DET006" and "time.sleep" in f.message
+               for f in findings)
+    sanctioned = {"wallclock_test_sanctions":
+                  ["test_wd.py::test_real_watchdog"]}
+    assert [f for f in check_source(src, "test_wd.py",
+                                    manifest=sanctioned)
+            if f.rule == "DET006"] == []
+
+
+def test_det006_ignores_non_test_files():
+    findings = check_source(DET_FIXTURES["DET006"]["bad"], "bench.py",
+                            module="bench")
+    assert [f for f in findings if f.rule == "DET006"] == []
+
+
+def test_suppression_comment_tokens_only():
+    bad = DET_FIXTURES["DET002"]["bad"]
+    sup = bad.replace("    random.shuffle(xs)",
+                      "    random.shuffle(xs)  # skydet: disable=DET002")
+    findings = check_source(sup, "plan.py",
+                            module="skycomputing_tpu.chaos.plan")
+    assert all("shuffle" not in f.message for f in findings)
+    cfg = DetConfig(include_suppressed=True)
+    vis = check_source(sup, "plan.py", config=cfg,
+                       module="skycomputing_tpu.chaos.plan")
+    assert any(f.suppressed for f in vis)
+    # prose mentioning the syntax is inert (comment tokens only)
+    prose = '"""Use `# skydet: disable-file=DET002` to suppress."""\n' + bad
+    findings = check_source(prose, "plan.py",
+                            module="skycomputing_tpu.chaos.plan")
+    assert any(f.rule == "DET002" for f in findings)
+
+
+# --------------------------------------------------------------------------
+# the self-gate: the shipped tree is clean with ZERO suppressions
+# --------------------------------------------------------------------------
+
+
+def test_skydet_self_gate_is_green():
+    """The whole package + test tree passes skydet with ZERO
+    suppressions (include_suppressed would surface any), and every
+    declared pure_stdlib module still loads by file path."""
+    findings = check_paths(
+        [os.path.join(REPO_ROOT, "skycomputing_tpu"),
+         os.path.join(REPO_ROOT, "tests")],
+        config=DetConfig(include_suppressed=True),
+    ) + check_pure_stdlib_loads()
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_manifest_determinism_declarations_are_present():
+    """The MANIFEST keys skydet consumes exist and name real things —
+    a renamed module/test must update the declaration with it."""
+    m = default_manifest()
+    for dotted in m["deterministic_modules"] + m["one_rng_modules"]:
+        rel = dotted.split(".")
+        assert os.path.exists(
+            os.path.join(REPO_ROOT, *rel[:-1], rel[-1] + ".py")), dotted
+    for entry in m["wallclock_test_sanctions"]:
+        fname, test = entry.split("::")
+        path = os.path.join(REPO_ROOT, "tests", fname)
+        assert os.path.exists(path), entry
+        assert f"def {test.split('.')[0]}(" in open(path).read(), entry
+    assert "wall_s" in m["digest_excluded_fields"]
+    assert "request_id" in m["digest_excluded_fields"]
+
+
+def test_pure_stdlib_load_check_reports_broken_contract():
+    bogus = {"pure_stdlib": ["skycomputing_tpu.nope.missing"]}
+    findings = check_pure_stdlib_loads(manifest=bogus)
+    assert len(findings) == 1 and findings[0].rule == "DET000"
+    assert "no such file" in findings[0].message
+    # a real package-coupled module (relative imports) fails standalone
+    coupled = {"pure_stdlib": ["skycomputing_tpu.serving.engine"]}
+    findings = check_pure_stdlib_loads(manifest=coupled)
+    assert len(findings) == 1 and "failed to load" in findings[0].message
+
+
+# --------------------------------------------------------------------------
+# the id(optimizer) cache-key pin: keyed lifetime, regression-guarded
+# --------------------------------------------------------------------------
+
+
+def test_optimizer_id_key_is_pinned():
+    """``get_stage_programs`` keys on ``id(optimizer)`` — sound ONLY
+    because ``_StagePrograms.__init__`` strong-references the optimizer
+    for the cache entry's lifetime (the MANIFEST id_key_pins rationale).
+    Pins: the reference exists, identity keying shares/splits entries
+    correctly, and after dropping every external reference the entry
+    still holds the object so its id cannot be recycled into a false
+    cache hit."""
+    import gc
+
+    import optax
+
+    from skycomputing_tpu.parallel.pipeline import (
+        _PROGRAM_CACHE,
+        clear_program_cache,
+        get_stage_programs,
+    )
+
+    cfgs = [dict(layer_type="MatmulStack", features=8, depth=1)]
+    clear_program_cache()
+    try:
+        opt = optax.sgd(1e-2)
+        p1 = get_stage_programs(cfgs, opt)
+        assert p1.optimizer is opt  # the pin itself
+        assert get_stage_programs(cfgs, opt) is p1
+        # equal hyperparameters, different object: must NOT share
+        assert get_stage_programs(cfgs, optax.sgd(1e-2)) is not p1
+        pinned_id = id(opt)
+        del opt
+        gc.collect()
+        assert any(e is p1 and id(e.optimizer) == pinned_id
+                   for e in _PROGRAM_CACHE.values())
+        # id-recycling probes: fresh optimizers may land on any freed
+        # address, but NEVER on the pinned one — so never a false hit
+        for _ in range(16):
+            assert get_stage_programs(cfgs, optax.sgd(1e-2)) is not p1
+    finally:
+        clear_program_cache()
+
+
+# --------------------------------------------------------------------------
+# solver clock injection (the DET001 fix, behavior-pinned)
+# --------------------------------------------------------------------------
+
+
+def test_solver_wall_cap_reads_the_injected_clock():
+    """The anneal wall cap consults the injected ``clock`` (the only
+    wall read in the module): a fake that jumps past the deadline skips
+    every anneal round deterministically, and the result is still a
+    valid partition."""
+    import random as _random
+
+    from skycomputing_tpu.dynamics.solver import solve_contiguous_minmax
+
+    rng = _random.Random(0)
+    L, D = 26, 13  # D > exact_limit -> the greedy/anneal path
+    layer_cost = [1.0 + rng.random() for _ in range(L)]
+    layer_mem = [1.0] * L
+    device_time = [1.0 + rng.random() for _ in range(D)]
+    device_mem = [float(L)] * D
+    calls = []
+
+    def fake_clock():
+        calls.append(1)
+        return 1e9 * len(calls)  # second read is past any deadline
+
+    res = solve_contiguous_minmax(
+        layer_cost, layer_mem, device_time, device_mem,
+        use_native=False, anneal_evals=10, anneal_rounds=2,
+        clock=fake_clock,
+    )
+    assert calls, "the wall cap must read the injected clock"
+    assert res.slices[0][0] == 0 and res.slices[-1][1] == L
+    assert all(a[1] == b[0]
+               for a, b in zip(res.slices, res.slices[1:]))
+
+
+# --------------------------------------------------------------------------
+# CLI contract + tools/_loader
+# --------------------------------------------------------------------------
+
+
+def test_skydet_cli_exit_codes_json_and_changed_only(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(DET_FIXTURES["DET004"]["bad"])
+    clean = tmp_path / "clean.py"
+    clean.write_text(DET_FIXTURES["DET004"]["clean"])
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.skydet", str(bad), "--format=json"],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=env,
+    )
+    assert proc.returncode == 1, proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is False
+    assert payload["counts"].get("DET004", 0) >= 1
+    assert all(
+        {"rule", "path", "line", "message", "fixit"} <= set(f)
+        for f in payload["findings"]
+    )
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.skydet", str(clean), "--strict"],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.skydet", str(clean),
+         "--select=DET999", "--strict"],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=env,
+    )
+    assert proc.returncode == 2
+
+    # --changed-only: explicit FILE args are the change set verbatim
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.skydet", str(bad),
+         "--changed-only"],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=env,
+    )
+    assert proc.returncode == 1
+    assert "DET004" in proc.stdout
+
+
+@pytest.mark.slow
+def test_skydet_gate_command_is_green():
+    """The exact CI gate command over the shipped tree: rc 0.  Marked
+    slow: it duplicates ``test_skydet_self_gate_is_green`` through the
+    subprocess CLI (a second full-tree scan), and the CI lint job runs
+    this exact command anyway — tier-1 keeps the in-process pin only."""
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.skydet", "skycomputing_tpu/",
+         "tests/", "--strict"],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_loader_reuses_and_falls_back(monkeypatch):
+    """``tools/_loader.py``: file-path loads register once and are
+    shared; ``load_module`` survives a broken package import by falling
+    back to the standalone file-path load (the bare-runner mode)."""
+    import importlib
+
+    from tools._loader import load_by_path, load_module
+
+    m1 = load_by_path("_skytpu_loader_test", "skycomputing_tpu",
+                      "workload", "scenario.py")
+    m2 = load_by_path("_skytpu_loader_test", "skycomputing_tpu",
+                      "workload", "scenario.py")
+    assert m1 is m2 and m1.scenario_names()
+
+    def boom(name):
+        raise ImportError(f"no {name} on a bare runner")
+
+    monkeypatch.setattr(importlib, "import_module", boom)
+    wl = load_module("skycomputing_tpu.workload.scenario",
+                     fallback_name="_skytpu_loader_test_fb")
+    assert wl.scenario_names() == m1.scenario_names()
+    # and the loaded catalog replays byte-identically either way
+    a = wl.get_scenario("tenant_mix").digest()
+    b = m1.get_scenario("tenant_mix").digest()
+    assert a == b
+
+
+def test_det_rule_catalog_is_documented():
+    """Every shipped DET rule ID appears in docs/static_analysis.md."""
+    doc = open(os.path.join(REPO_ROOT, "docs",
+                            "static_analysis.md")).read()
+    for rule_id in DET_RULES:
+        assert rule_id in doc, f"{rule_id} missing from the doc catalog"

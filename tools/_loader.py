@@ -1,0 +1,65 @@
+"""Shared module loading for the pure-stdlib CLI gates (skylint idiom).
+
+Every smoke tool used to carry its own copy of the same ~15 lines: a
+``spec_from_file_location`` helper plus a try/except package-import
+fallback per module.  This is that boilerplate, once.  Pure stdlib by
+contract (see the skyaudit MANIFEST ``pure_stdlib`` list): a bare CI
+runner with no jax/numpy installed imports this module fine, so the
+tools' only obligation is to put the repo root on ``sys.path`` first::
+
+    _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, _ROOT)
+    from tools._loader import load_module
+
+``load_module`` prefers the real package import (one shared module
+object, normal ``isinstance`` identity) and falls back to a file-path
+load under a private ``sys.modules`` name — the mode the lint job
+exercises on bare runners, where importing the package would drag in
+jax at ``skycomputing_tpu/__init__.py``.
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import os
+import sys
+
+#: repo root — ``tools/`` sits directly under it
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_by_path(name: str, *parts: str, root: str = ROOT):
+    """Load ``os.path.join(root, *parts)`` as module ``name`` by file
+    path, registering it in ``sys.modules`` (re-used if already
+    loaded — repeat callers share one module object)."""
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(root, *parts)
+    )
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[name] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+def load_module(dotted: str, fallback_name: str = "", root: str = ROOT):
+    """Import ``dotted`` as a package module; on ANY failure (bare
+    runner — the package ``__init__`` needs jax) fall back to loading
+    the module's own file standalone under ``fallback_name``.
+
+    Only sensible for modules that are pure stdlib by contract (the
+    MANIFEST ``pure_stdlib`` list): anything else would just move the
+    ImportError into the fallback."""
+    try:
+        return importlib.import_module(dotted)
+    except Exception:  # pragma: no cover - exercised on bare CI runners
+        parts = dotted.split(".")
+        return load_by_path(
+            fallback_name or f"_skytpu_{parts[-1]}",
+            *parts[:-1], parts[-1] + ".py", root=root,
+        )
+
+
+__all__ = ["ROOT", "load_by_path", "load_module"]

@@ -19,40 +19,20 @@ Usage::
 
 from __future__ import annotations
 
-import importlib.util
 import os
 import sys
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
 
+from tools._loader import load_module  # noqa: E402 - pure stdlib helper
 
-def _load_by_path(name: str, *parts: str):
-    spec = importlib.util.spec_from_file_location(
-        name, os.path.join(_ROOT, *parts)
-    )
-    module = importlib.util.module_from_spec(spec)
-    sys.modules[name] = module
-    spec.loader.exec_module(module)
-    return module
-
-
-try:
-    from skycomputing_tpu.chaos import plan as _cp
-except Exception:  # pragma: no cover - exercised on bare CI runners
-    _cp = _load_by_path(
-        "_skytpu_chaos_smoke",
-        "skycomputing_tpu", "chaos", "plan.py",
-    )
-
+_cp = load_module("skycomputing_tpu.chaos.plan",
+                  fallback_name="_skytpu_chaos_smoke")
 # the workload pairing must resolve against the scenario catalog, and
 # that catalog is itself pure stdlib — load it the same way
-try:
-    from skycomputing_tpu.workload import scenario as _wl
-except Exception:  # pragma: no cover - exercised on bare CI runners
-    _wl = _load_by_path(
-        "_skytpu_chaos_smoke_wl",
-        "skycomputing_tpu", "workload", "scenario.py",
-    )
+_wl = load_module("skycomputing_tpu.workload.scenario",
+                  fallback_name="_skytpu_chaos_smoke_wl")
 
 
 def check(cond, message):

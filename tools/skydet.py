@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""skydet CLI: determinism & digest-integrity analysis for the replay planes.
+
+Usage::
+
+    python -m tools.skydet skycomputing_tpu/ tests/ --strict
+    python -m tools.skydet skycomputing_tpu/ --format=json
+    python -m tools.skydet --changed-only            # pre-commit mode
+    python -m tools.skydet tests/ --select=DET006
+
+Six rule families over the AST, configured from the skyaudit MANIFEST's
+determinism declarations (rule catalog in ``docs/static_analysis.md``):
+
+- DET001/DET002: clock & seed discipline — wall-clock reads in declared
+  deterministic modules, global-state RNG, one-rng-per-plan;
+- DET003/DET004: digest integrity — excluded fields and unsorted
+  iteration on digest paths, ``id()``/``hash()`` in content identities;
+- DET005: program-cache key completeness (the serving/mesh hole);
+- DET006: the test-flakiness gate (wall-clock asserts, raw sleeps).
+
+The run also proves every MANIFEST ``pure_stdlib`` module still loads
+by file path on a bare runner (failures report as DET000) — the
+contract the smoke gates and this very CLI depend on.
+
+Exit codes: 0 clean, 1 findings, 2 bad invocation — same contract as
+skylint/skyaudit.  ``--changed-only`` checks only files git says
+changed (every skydet rule is per-file, so no whole-graph re-scan is
+needed; the load check still runs, it is milliseconds).
+
+Suppression: ``# skydet: disable=DET001`` on the finding's line; the
+shipped gate runs with ZERO suppressions — exemptions live in the
+MANIFEST with a rationale (``id_key_pins``,
+``wallclock_test_sanctions``, ``rng_global_sanctions``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+from tools._loader import load_by_path  # noqa: E402 - pure stdlib helper
+
+_engine = load_by_path("skydet_engine", "skycomputing_tpu", "analysis",
+                       "determinism.py")
+DetConfig = _engine.DetConfig
+RULES = _engine.RULES
+check_paths = _engine.check_paths
+check_pure_stdlib_loads = _engine.check_pure_stdlib_loads
+
+#: default scan scope when no paths are given (the CI gate's scope)
+DEFAULT_PATHS = ("skycomputing_tpu", "tests")
+
+
+def _parse_rule_set(spec: str, strict: bool) -> set:
+    ids = {s.strip().upper() for s in spec.split(",") if s.strip()}
+    unknown = ids - set(RULES) - {"DET000"}
+    if unknown:
+        msg = f"unknown rule id(s): {', '.join(sorted(unknown))}"
+        if strict:
+            print(f"skydet: error: {msg}", file=sys.stderr)
+            raise SystemExit(2)
+        print(f"skydet: warning: {msg}", file=sys.stderr)
+    return ids
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="skydet", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files and/or directories to check "
+                         f"(default: {' '.join(DEFAULT_PATHS)})")
+    ap.add_argument("--strict", action="store_true",
+                    help="fail on unknown rule ids; intended for CI")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--ignore", default="",
+                    help="comma-separated rule ids to skip")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also report suppressed findings (marked)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="check only files git says changed (all skydet "
+                         "rules are per-file); explicit FILE args "
+                         "override git")
+    ap.add_argument("--no-load-check", action="store_true",
+                    help="skip the pure_stdlib file-path load "
+                         "verification")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or [
+        p for p in (os.path.join(_ROOT, d) for d in DEFAULT_PATHS)
+        if os.path.exists(p)
+    ]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"skydet: error: no such path: {p}", file=sys.stderr)
+            return 2
+
+    if args.changed_only:
+        _changed = load_by_path("skydet_changed", "tools", "changed.py")
+        changed = _changed.changed_python_files(paths, cwd=_ROOT)
+        if changed is None:
+            print("skydet: --changed-only: git unavailable, checking "
+                  "everything", file=sys.stderr)
+        elif not changed:
+            print("skydet: --changed-only: no python changes, clean",
+                  file=sys.stderr)
+            if args.format == "json":
+                print(json.dumps({"findings": [], "counts": {},
+                                  "ok": True}, indent=2))
+            return 0
+        else:
+            paths = changed
+
+    config = DetConfig(
+        select=_parse_rule_set(args.select, args.strict)
+        if args.select else None,
+        ignore=_parse_rule_set(args.ignore, args.strict)
+        if args.ignore else set(),
+        include_suppressed=args.show_suppressed,
+    )
+    findings = check_paths(paths, config)
+    if not args.no_load_check:
+        findings = check_pure_stdlib_loads() + findings
+    active = [f for f in findings if not f.suppressed]
+
+    if args.format == "json":
+        counts: dict = {}
+        for f in active:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "counts": counts,
+            "ok": not active,
+        }, indent=2))
+    else:
+        for f in findings:
+            tag = " (suppressed)" if f.suppressed else ""
+            print(f.format() + tag)
+        if active:
+            print(f"skydet: {len(active)} finding(s) in "
+                  f"{len({f.path for f in active})} file(s)",
+                  file=sys.stderr)
+        else:
+            print("skydet: clean", file=sys.stderr)
+
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
