@@ -200,6 +200,13 @@ MANIFEST: Dict[str, Any] = {
         "chaos": {"modules": ["skycomputing_tpu.chaos"],
                   "may_import": ["fleet", "serving", "telemetry",
                                  "utils"]},
+        # the disagg plane specializes the fleet into role pools, so it
+        # sits beside chaos ABOVE fleet/serving; its handoff core is
+        # pure stdlib (below) and the plan_check edge is lazy
+        # (in-function) so analysis never appears here
+        "disagg": {"modules": ["skycomputing_tpu.disagg"],
+                   "may_import": ["fleet", "serving", "telemetry",
+                                  "utils"]},
         "tools": {"modules": ["tools"], "may_import": ["*"]},
     },
     # stdlib-only by contract: loadable by FILE PATH on a bare runner
@@ -213,6 +220,10 @@ MANIFEST: Dict[str, Any] = {
         # scenario core: tools/chaos_smoke.py file-path-loads it on a
         # bare runner; injector/invariants live outside this contract)
         "skycomputing_tpu.chaos.plan",
+        # the KV-handoff record + conservation ledger (same contract as
+        # the scenario core: tools/disagg_smoke.py file-path-loads it on
+        # a bare runner; the jax-backed pools live outside this contract)
+        "skycomputing_tpu.disagg.handoff",
         # the partition/mesh-shape solver: pure math by contract, so
         # tools/mesh_smoke.py can file-path-load it on a bare lint runner
         "skycomputing_tpu.dynamics.solver",
@@ -251,6 +262,7 @@ MANIFEST: Dict[str, Any] = {
         "tools.changed",
         "tools.chaos_smoke",
         "tools.chunk_smoke",
+        "tools.disagg_smoke",
         # mesh-shape-search contracts (file-path-loads dynamics/solver);
         # its jax section self-SKIPs on bare runners
         "tools.mesh_smoke",

@@ -967,7 +967,11 @@ def verify_scale_payload(scale: Any) -> List[str]:
     ``replicas`` (current live replica count, positive int), ``delta``
     (positive int, how many replicas the decision moves), optional
     ``min_replicas`` / ``max_replicas`` bounds (positive ints,
-    ``min <= max``), and for ADDs a chip-budget feasibility pair:
+    ``min <= max``), an optional ``pool`` (non-empty role string —
+    disaggregated fleets scale one pool at a time, and ``replicas`` /
+    the bounds are then THAT pool's, so the same pre-flight enforces
+    per-pool floors and ceilings), and for ADDs a chip-budget
+    feasibility pair:
     ``chips_required`` (positive int) must fit ``chips_free``
     (non-negative int) — an add the device pool cannot back dies HERE,
     with the fleet untouched, exactly like an infeasible re-form dies
@@ -999,6 +1003,12 @@ def verify_scale_payload(scale: Any) -> List[str]:
     if not _pos_int(delta):
         problems.append(
             f"scale.delta must be a positive int, got {delta!r}"
+        )
+    pool = scale.get("pool")
+    if pool is not None and (not isinstance(pool, str) or not pool):
+        problems.append(
+            f"scale.pool must be a non-empty role string when "
+            f"present, got {pool!r}"
         )
     lo, hi = scale.get("min_replicas"), scale.get("max_replicas")
     for key, v in (("min_replicas", lo), ("max_replicas", hi)):
@@ -1046,6 +1056,126 @@ def verify_scale_payload(scale: Any) -> List[str]:
     return problems
 
 
+def _hex_digest(v: Any) -> bool:
+    """A sha256 hex digest: 64 lowercase hex chars."""
+    return (isinstance(v, str) and len(v) == 64
+            and all(c in "0123456789abcdef" for c in v))
+
+
+def verify_handoff_payload(handoff: Any,
+                           geometry: Any = None) -> List[str]:
+    """Problems with a prefill→decode handoff payload (empty = valid).
+
+    Schema — what :meth:`~..disagg.handoff.HandoffRecord.to_dict`
+    emits and :class:`~..disagg.pools.DisaggFleet` re-verifies before
+    seating a record on a decode replica (verify-then-apply: a record
+    that cannot seat dies HERE, before any page is charged):
+    ``request_id`` non-negative int, ``source`` non-empty string,
+    ``prompt_len`` / ``prefilled_len`` / ``index`` / ``pages`` /
+    ``page_size`` / ``max_pages_per_request`` / ``stages`` positive
+    ints with ``prefilled_len >= prompt_len``,
+    ``pages <= max_pages_per_request``, and
+    ``pages * page_size >= index`` (the pages must cover the resume
+    index); ``checksum`` a sha256 hex digest; ``slab_checksums`` a list
+    of ``stages`` digests (one per stage, so corruption is
+    attributable); ``kv_dtype`` a non-empty string.
+
+    With ``geometry`` (the importing engine's
+    ``page_size`` / ``max_pages_per_request`` / ``stages`` /
+    ``kv_dtype``), the record's geometry must MATCH — a swap record
+    gathered under one page shape cannot seat under another, and a
+    dtype change would silently reinterpret every slab byte.  Pool
+    page COUNT may differ (sentinel tables are rebuilt at swap-in);
+    only the per-request shape is load-bearing.
+    """
+    if not isinstance(handoff, dict):
+        return [
+            f"handoff payload must be an object, got "
+            f"{type(handoff).__name__}"
+        ]
+    problems: List[str] = []
+    rid = handoff.get("request_id")
+    if isinstance(rid, bool) or not isinstance(rid, int) or rid < 0:
+        problems.append(
+            f"handoff.request_id must be a non-negative int, got "
+            f"{rid!r}"
+        )
+    source = handoff.get("source")
+    if not isinstance(source, str) or not source:
+        problems.append(
+            f"handoff.source must be a non-empty replica name, got "
+            f"{source!r}"
+        )
+    for key in ("prompt_len", "prefilled_len", "index", "pages",
+                "page_size", "max_pages_per_request", "stages"):
+        if not _pos_int(handoff.get(key)):
+            problems.append(
+                f"handoff.{key} must be a positive int, got "
+                f"{handoff.get(key)!r}"
+            )
+    plen, wlen = handoff.get("prompt_len"), handoff.get("prefilled_len")
+    if _pos_int(plen) and _pos_int(wlen) and wlen < plen:
+        problems.append(
+            f"handoff.prefilled_len {wlen} is below prompt_len {plen} "
+            f"— the prefill side must at least cover the prompt"
+        )
+    pages, mpr = handoff.get("pages"), handoff.get(
+        "max_pages_per_request")
+    if _pos_int(pages) and _pos_int(mpr) and pages > mpr:
+        problems.append(
+            f"handoff.pages {pages} exceeds max_pages_per_request "
+            f"{mpr}"
+        )
+    ps, idx = handoff.get("page_size"), handoff.get("index")
+    if _pos_int(pages) and _pos_int(ps) and _pos_int(idx) \
+            and pages * ps < idx:
+        problems.append(
+            f"handoff: {pages} pages of {ps} tokens cannot cover "
+            f"page-table index {idx}"
+        )
+    if not _hex_digest(handoff.get("checksum")):
+        problems.append(
+            "handoff.checksum must be a 64-char lowercase sha256 hex "
+            "digest"
+        )
+    slabs = handoff.get("slab_checksums")
+    stages = handoff.get("stages")
+    if (not isinstance(slabs, (list, tuple))
+            or not all(_hex_digest(c) for c in slabs)
+            or (_pos_int(stages) and len(slabs) != stages)):
+        problems.append(
+            f"handoff.slab_checksums must be {stages!r} sha256 hex "
+            f"digests (one per stage), got {slabs!r}"
+        )
+    kvd = handoff.get("kv_dtype")
+    if not isinstance(kvd, str) or not kvd:
+        problems.append(
+            f"handoff.kv_dtype must be a non-empty dtype name, got "
+            f"{kvd!r}"
+        )
+    if geometry is None:
+        return problems
+    if not isinstance(geometry, dict):
+        problems.append(
+            f"importing geometry must be an object, got "
+            f"{type(geometry).__name__}"
+        )
+        return problems
+    for key in ("page_size", "max_pages_per_request", "stages",
+                "kv_dtype"):
+        if key not in geometry:
+            continue
+        theirs, ours = handoff.get(key), geometry.get(key)
+        if theirs != ours:
+            problems.append(
+                f"handoff geometry mismatch: record carries "
+                f"{key}={theirs!r} but the importing engine has "
+                f"{ours!r} — a record gathered under one shape cannot "
+                f"seat under another"
+            )
+    return problems
+
+
 #: the chaos plane's sanctioned fault vocabulary, duplicated BY VALUE
 #: from ``chaos.plan.FAULT_KINDS`` (the SCALE_ADD idiom: the verifier
 #: must not import the layer it verifies; tests pin the two in sync)
@@ -1055,7 +1185,14 @@ FAULT_KINDS = (
     "swap_corruption",
     "reform_failure",
     "admission_blip",
+    "handoff_corruption",
 )
+
+#: fault kinds whose target selector is the FLEET itself, not a
+#: replica: admission_blip flips the fleet front door, and
+#: handoff_corruption flips a byte in the fleet-held prefill→decode
+#: payload (the disagg handoff plane lives on the fleet, between pools)
+FLEET_TARGET_KINDS = ("admission_blip", "handoff_corruption")
 
 
 def verify_fault_plan(plan: Any) -> List[str]:
@@ -1070,7 +1207,8 @@ def verify_fault_plan(plan: Any) -> List[str]:
     numbers, and a non-empty ``events`` list where each event carries a
     non-negative ``tick``, a ``kind`` from the sanctioned vocabulary, a
     ``target`` selector consistent with its kind (``admission_blip``
-    must target ``fleet``; every other kind must NOT), a positive
+    and ``handoff_corruption`` must target ``fleet``; every other kind
+    must NOT), a positive
     ``duration``, and kind-consistent ``params`` (``stage_slowdown``
     needs ``seconds > 0``, ``reform_failure`` needs ``builds >= 1``).
     """
@@ -1138,12 +1276,12 @@ def verify_fault_plan(plan: Any) -> List[str]:
                 f"events[{i}].target must be a non-empty selector, "
                 f"got {target!r}"
             )
-        elif kind == "admission_blip" and target != "fleet":
+        elif kind in FLEET_TARGET_KINDS and target != "fleet":
             problems.append(
-                f"events[{i}]: admission_blip must target 'fleet', "
+                f"events[{i}]: {kind} must target 'fleet', "
                 f"got {target!r}"
             )
-        elif kind != "admission_blip" and target == "fleet":
+        elif kind not in FLEET_TARGET_KINDS and target == "fleet":
             problems.append(
                 f"events[{i}]: {kind} needs a replica selector, got "
                 f"'fleet'"
@@ -1422,6 +1560,7 @@ __all__ = [
     "has_plan",
     "verify_allocation_payload",
     "verify_fault_plan",
+    "verify_handoff_payload",
     "verify_mesh_payload",
     "verify_scale_payload",
     "verify_pipeline",

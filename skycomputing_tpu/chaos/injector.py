@@ -27,7 +27,11 @@ poisons every downstream invariant.  The one deliberate exception to
 exact-tick firing: a ``pending_removal`` event whose tick passes with
 no drain in flight ARMS (logged) and fires at the first later tick
 the autoscaler is mid-removal — "kill the next drain" is the only
-honest way to hit a window whose exact tick the plan cannot know.  The log carries NO request ids or
+honest way to hit a window whose exact tick the plan cannot know.  A
+``handoff_corruption`` that finds nothing in flight arms the same way
+and fires at the first tick a handoff record IS mid-flight (the
+KV-handoff window is one tick wide by construction — "corrupt the
+next handoff" is the only honest way to hit it).  The log carries NO request ids or
 wall times (ids mint from a process-global counter), and its
 ``deterministic_log()`` projection — everything except which live
 replica a load-based selector resolved to — is byte-identical across
@@ -49,6 +53,7 @@ from ..utils import Logger
 from .invariants import fleet_settled
 from .plan import (
     ADMISSION_BLIP,
+    HANDOFF_CORRUPTION,
     REFORM_FAILURE,
     REPLICA_CRASH,
     STAGE_SLOWDOWN,
@@ -141,11 +146,11 @@ class FaultInjector:
             # one removal, and killing the same draining replica twice
             # proves nothing — the rest stay armed for the next drain
             for i, event in enumerate(self._armed):
-                _, note = self._resolve(fleet, event)
-                if note is None:
-                    self._armed.pop(i)
-                    self._apply(fleet, event)
-                    break
+                if not self._armed_ready(fleet, event):
+                    continue
+                self._armed.pop(i)
+                self._apply(fleet, event)
+                break
         for event in self._by_tick.get(fleet.tick, ()):
             self._apply(fleet, event)
 
@@ -178,6 +183,16 @@ class FaultInjector:
             )
 
     # --- event application --------------------------------------------------
+    def _armed_ready(self, fleet, event: FaultEvent) -> bool:
+        """Can this ARMED event fire now?  ``pending_removal`` needs a
+        drain in flight; ``handoff_corruption`` needs a handoff record
+        mid-flight (the one-tick PENDING window)."""
+        if event.kind == HANDOFF_CORRUPTION:
+            ledger = getattr(fleet, "ledger", None)
+            return ledger is not None and bool(ledger.pending())
+        _, note = self._resolve(fleet, event)
+        return note is None
+
     def _resolve(self, fleet, event: FaultEvent):
         """(replica-or-None, note): the live target, or why there is
         none.  ``fleet``-targeted events resolve to (None, None)."""
@@ -240,6 +255,21 @@ class FaultInjector:
                         if rid is None:
                             ok = False
                             note = "no swap record to corrupt"
+            elif event.kind == HANDOFF_CORRUPTION:
+                # duck-typed: only a disagg fleet exposes the hook, and
+                # an honest skip beats a monkeypatch on a plain fleet
+                hook = getattr(fleet, "corrupt_handoff", None)
+                if hook is None:
+                    ok, note = False, "fleet has no handoff plane"
+                else:
+                    try:
+                        rid = hook(force=params.get("force", True))
+                    except (KeyError, ValueError) as exc:
+                        ok, note = False, str(exc)
+                    else:
+                        if rid is None:
+                            ok = False
+                            note = "no handoff record to corrupt"
             elif event.kind == ADMISSION_BLIP:
                 fleet.admission.blip_active = True
                 clear = fleet.tick + event.duration
@@ -251,6 +281,14 @@ class FaultInjector:
                 raise ValueError(
                     f"unsanctioned fault kind {event.kind!r}"
                 )
+        if (not ok and event.kind == HANDOFF_CORRUPTION
+                and note == "no handoff record to corrupt"
+                and event not in self._armed):
+            # the in-flight window is one tick wide: arm and poison the
+            # NEXT handoff instead of dying (a fleet with no handoff
+            # plane at all stays an honest skip — it will never fire)
+            self._armed.append(event)
+            note = f"{note}; armed"
         entry = dict(
             tick=int(fleet.tick), kind=event.kind,
             target=event.target,

@@ -23,7 +23,12 @@ named checks:
   non-decreasing, and per-reason rejection counts sum to the total;
 - **recovery_within_budget** — the fleet returned to a settled state
   within ``recovery_budget_ticks`` of the last injected fault
-  (time-to-healthy, gated).
+  (time-to-healthy, gated);
+- **ledger_conserved** — on a disaggregated fleet (duck-typed off
+  ``fleet.ledger``), the KV-handoff ledger's conservation invariant
+  holds (every enqueued record in exactly one of pending / delivered /
+  failed-with-reason) and no handoff is left stranded PENDING after
+  the run drained; a plain fleet passes trivially.
 
 :func:`make_probe` builds the per-tick ``sample_fn`` the player feeds
 the timeline with; :func:`fleet_settled` is the shared 'healthy again'
@@ -246,6 +251,42 @@ def _check_counters_monotonic(fleet, report) -> AuditCheck:
     )
 
 
+def _check_ledger_conserved(fleet) -> AuditCheck:
+    ledger = getattr(fleet, "ledger", None)
+    if ledger is None:
+        return AuditCheck(
+            "ledger_conserved", True, "fleet has no handoff ledger"
+        )
+    bad: List[str] = []
+    summary = ledger.audit()
+    if not summary["conservation_ok"]:
+        bad.append(
+            f"conservation broken: enqueued={summary['enqueued_total']}"
+            f" pending={summary['pending']}"
+            f" delivered={summary['delivered']}"
+            f" failed={summary['failed']}"
+        )
+    if summary["pending"]:
+        # the replay ran its idle epilogue: anything still PENDING was
+        # stranded in flight, exactly what the ledger exists to forbid
+        bad.append(
+            f"{summary['pending']} handoff(s) stranded PENDING after "
+            f"the run drained"
+        )
+    reasons = ", ".join(
+        f"{r} x{n}"
+        for r, n in sorted(summary["failed_reasons"].items())
+    )
+    return AuditCheck(
+        "ledger_conserved", not bad,
+        "; ".join(bad) if bad
+        else (f"{summary['total']} handoffs conserved "
+              f"({summary['delivered']} delivered, "
+              f"{summary['failed']} failed"
+              + (f": {reasons}" if reasons else "") + ")"),
+    )
+
+
 def _check_recovery(fleet, report, injector,
                     budget: Optional[int]) -> AuditCheck:
     if injector is None or injector.last_fault_tick is None:
@@ -308,6 +349,7 @@ def audit_run(
         audit.checks.append(_check_token_identity(report, reference))
     audit.checks.append(_check_page_consistency(fleet))
     audit.checks.append(_check_counters_monotonic(fleet, report))
+    audit.checks.append(_check_ledger_conserved(fleet))
     audit.checks.append(
         _check_recovery(fleet, report, injector,
                         recovery_budget_ticks)

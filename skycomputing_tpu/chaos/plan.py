@@ -70,6 +70,7 @@ STAGE_SLOWDOWN = "stage_slowdown"
 SWAP_CORRUPTION = "swap_corruption"
 REFORM_FAILURE = "reform_failure"
 ADMISSION_BLIP = "admission_blip"
+HANDOFF_CORRUPTION = "handoff_corruption"
 
 FAULT_KINDS: Tuple[str, ...] = (
     REPLICA_CRASH,
@@ -77,7 +78,13 @@ FAULT_KINDS: Tuple[str, ...] = (
     SWAP_CORRUPTION,
     REFORM_FAILURE,
     ADMISSION_BLIP,
+    HANDOFF_CORRUPTION,
 )
+
+#: kinds whose selector is the FLEET itself, not any replica:
+#: admission_blip flips the front door, handoff_corruption rots a
+#: fleet-held prefill→decode payload (``DisaggFleet.corrupt_handoff``)
+_FLEET_TARGET_KINDS = (ADMISSION_BLIP, HANDOFF_CORRUPTION)
 
 #: selectors that name a replica (everything except ``fleet``)
 _REPLICA_SELECTOR_PREFIXES = ("index:", "name:")
@@ -85,7 +92,7 @@ _BARE_SELECTORS = ("pending_removal", "fleet")
 
 
 def _validate_target(kind: str, target: str) -> None:
-    if kind == ADMISSION_BLIP:
+    if kind in _FLEET_TARGET_KINDS:
         if target != "fleet":
             raise ValueError(
                 f"{kind} targets fleet-level machinery; its selector "
@@ -169,6 +176,15 @@ def _validate_params(kind: str, params: Dict[str, Any],
             raise ValueError(
                 f"{kind} needs duration >= 1 tick, got {duration}"
             )
+    elif kind == HANDOFF_CORRUPTION:
+        # mirrors swap_corruption: one optional bool — with force and
+        # nothing in flight, the hook exports a handoff to poison
+        _reject_extra(("force",))
+        force = params.get("force", True)
+        if not isinstance(force, bool):
+            raise ValueError(
+                f"{kind} param 'force' must be a bool, got {force!r}"
+            )
     else:
         raise ValueError(
             f"unknown fault kind {kind!r}; known: {list(FAULT_KINDS)}"
@@ -250,6 +266,11 @@ class FaultPlan:
     ticks_scale: float = 1.0
     replicas: int = 2
     autoscale: bool = False
+    #: replay against a disaggregated fleet (prefill/decode pools with
+    #: the KV-handoff plane): the harness builds ``DisaggFleet`` with
+    #: one prefill replica and ``replicas - 1`` decode replicas, so
+    #: ``index:0`` deterministically names the prefill specialist
+    disagg: bool = False
     description: str = ""
 
     def __post_init__(self):
@@ -270,6 +291,11 @@ class FaultPlan:
             raise ValueError(
                 f"plan {self.name!r} needs replicas >= 1, got "
                 f"{self.replicas}"
+            )
+        if self.disagg and int(self.replicas) < 2:
+            raise ValueError(
+                f"plan {self.name!r} replays disaggregated: it needs "
+                f"replicas >= 2 (one prefill + at least one decode)"
             )
         for scale, value in (("rate_scale", self.rate_scale),
                              ("ticks_scale", self.ticks_scale)):
@@ -327,6 +353,7 @@ class FaultPlan:
             ticks_scale=self.ticks_scale,
             replicas=self.replicas,
             autoscale=self.autoscale,
+            disagg=self.disagg,
             recovery_budget_ticks=self.recovery_budget_ticks,
             description=self.description,
             events=[e.to_dict() for e in self.events],
@@ -521,12 +548,41 @@ def overload_then_crash(seed: int = 0) -> FaultPlan:
     )
 
 
+@register_fault_plan("prefill_kill_mid_handoff")
+def prefill_kill_mid_handoff(seed: int = 0) -> FaultPlan:
+    def corrupt(tick, jitter=0):
+        return FaultEvent(tick=tick, kind=HANDOFF_CORRUPTION,
+                          target="fleet", params=(("force", True),),
+                          jitter_ticks=jitter)
+
+    return FaultPlan(
+        name="prefill_kill_mid_handoff", seed=seed,
+        scenario="disagg_mix", ticks_scale=0.5,
+        replicas=3, disagg=True, recovery_budget_ticks=60,
+        events=(
+            corrupt(10),
+            # the prefill specialist dies with handoffs in flight:
+            # exported records are fleet-held, so the pump re-delivers
+            # them while the supervisor re-forms the pool
+            _crash(18, "index:0", jitter=2),
+            corrupt(34, jitter=2),
+        ),
+        description="a handoff payload is bit-flipped, then the "
+                    "prefill specialist is killed with handoffs in "
+                    "flight; the ledger conserves every record — "
+                    "corrupted ones recompute with a reason, in-"
+                    "flight ones re-deliver — and streams stay token-"
+                    "identical",
+    )
+
+
 __all__ = [
     "ADMISSION_BLIP",
     "FAULT_KINDS",
     "FAULT_PLANS",
     "FaultEvent",
     "FaultPlan",
+    "HANDOFF_CORRUPTION",
     "REFORM_FAILURE",
     "REPLICA_CRASH",
     "STAGE_SLOWDOWN",

@@ -1,0 +1,30 @@
+"""Disaggregated prefill/decode serving: role-specialized replica
+pools joined by a checksummed, ledgered KV-handoff plane.
+
+``handoff`` is pure stdlib by contract (the record/ledger contract,
+file-path-loadable by ``tools/disagg_smoke.py`` on a bare CI runner);
+``pools`` holds :class:`DisaggFleet`, the fleet subclass that runs the
+two pools and pumps handoffs between them.
+"""
+
+from .handoff import (
+    DELIVERED,
+    FAILED,
+    HANDOFF_STATES,
+    HandoffLedger,
+    HandoffRecord,
+    PENDING,
+)
+from .pools import DECODE, DisaggFleet, PREFILL
+
+__all__ = [
+    "DECODE",
+    "DELIVERED",
+    "DisaggFleet",
+    "FAILED",
+    "HANDOFF_STATES",
+    "HandoffLedger",
+    "HandoffRecord",
+    "PENDING",
+    "PREFILL",
+]

@@ -37,6 +37,16 @@ on the ``("fleet", "autoscaler")`` lane, and in the counter-disciplined
 The autoscaler never touches an engine: it reads fleet-level evidence
 and calls the two fleet verbs.  ``plan_check`` is imported lazily at
 decision time (the repo-wide idiom for analysis-layer verifiers).
+
+**Per-pool mode** (disaggregated fleets): construct with ``pools``
+mapping each replica role to its own ``min_replicas`` /
+``max_replicas`` bounds and the SLO ``signals`` that attribute burn to
+it.  Sustained burn then scales the pool whose signals match the firing
+targets — TTFT burn grows the prefill pool, TPOT/queue-depth burn grows
+the decode pool — and sustained slack drains the pool furthest above
+its floor.  Every decision payload carries the ``pool`` it targets, so
+``verify_scale_payload`` pre-flights the per-pool bounds and the chip
+budget before any mutation, exactly as in the monolithic mode.
 """
 
 from __future__ import annotations
@@ -51,6 +61,15 @@ from .replica import HEALTHY, RETIRED
 SCALE_UP = "scale_up"
 SCALE_DOWN = "scale_down"
 SCALE_REJECTED = "scale_rejected"
+
+#: default burn-attribution signals per well-known pool role: a firing
+#: SLO target whose name or metric contains one of these substrings
+#: charges its burn to that pool.  TTFT is prefill work by definition;
+#: TPOT and queue depth are decode-side pressure (slots and pace).
+POOL_SIGNALS = {
+    "prefill": ("ttft",),
+    "decode": ("tpot", "queue"),
+}
 
 
 class FleetAutoscaler:
@@ -67,6 +86,7 @@ class FleetAutoscaler:
         down_streak: int = 24,
         cooldown_ticks: int = 32,
         slack_utilization: float = 0.3,
+        pools: Optional[Dict[str, Dict[str, Any]]] = None,
         logger: Optional[Logger] = None,
     ):
         if min_replicas < 1:
@@ -107,6 +127,40 @@ class FleetAutoscaler:
         self.down_streak = int(down_streak)
         self.cooldown_ticks = int(cooldown_ticks)
         self.slack_utilization = float(slack_utilization)
+        #: per-role pool config (disaggregated fleets): role ->
+        #: dict(min_replicas, max_replicas, signals).  Empty dict =
+        #: monolithic mode, every decision fleet-wide.
+        self.pools: Dict[str, Dict[str, Any]] = {}
+        for pool, cfg in (pools or {}).items():
+            if not isinstance(pool, str) or not pool:
+                raise ValueError(
+                    f"pool role must be a non-empty string, got {pool!r}"
+                )
+            if not isinstance(cfg, dict):
+                raise ValueError(
+                    f"pool {pool!r} config must be a dict, got "
+                    f"{type(cfg).__name__}"
+                )
+            lo = int(cfg.get("min_replicas", 1))
+            hi = cfg.get("max_replicas")
+            hi = None if hi is None else int(hi)
+            if lo < 1:
+                raise ValueError(
+                    f"pool {pool!r} min_replicas must be >= 1, got {lo}"
+                )
+            if hi is not None and hi < lo:
+                raise ValueError(
+                    f"pool {pool!r} max_replicas ({hi}) must be >= "
+                    f"min_replicas ({lo})"
+                )
+            signals = tuple(
+                str(s).lower()
+                for s in (cfg.get("signals")
+                          or POOL_SIGNALS.get(pool)
+                          or (pool,))
+            )
+            self.pools[pool] = dict(min_replicas=lo, max_replicas=hi,
+                                    signals=signals)
         self._logger = logger or Logger()
         self._slack_streak = 0
         self._cooldown_until = 0
@@ -144,18 +198,66 @@ class FleetAutoscaler:
         not being measured."""
         return int(getattr(fleet.slo, "firing_streak", 0) or 0)
 
-    def _payload(self, fleet, action: str, live: int) -> Dict[str, Any]:
+    def _pool_live(self, fleet, pool: str) -> List[Any]:
+        """Live replicas carrying ``pool``'s role."""
+        return [r for r in self._live_replicas(fleet)
+                if getattr(r, "role", "") == pool]
+
+    def _burn_pool(self, fleet) -> Optional[str]:
+        """The pool the current SLO burn charges to (per-pool mode).
+
+        Matches every firing target's name AND metric against each
+        pool's signal substrings, in pool declaration order.  Burn no
+        signal claims falls to the LAST declared pool — unattributed
+        pressure still grows capacity somewhere, and decode (declared
+        last by :class:`~..disagg.pools.DisaggFleet`) is the
+        general-purpose sink."""
+        if not self.pools:
+            return None
+        firing = tuple(getattr(fleet.slo, "firing", ()) or ())
+        metrics = {
+            str(t.name): str(getattr(t, "metric", ""))
+            for t in (getattr(fleet.slo, "targets", ()) or ())
+        }
+        for pool, cfg in self.pools.items():
+            for name in firing:
+                hay = f"{name} {metrics.get(str(name), '')}".lower()
+                if any(sig in hay for sig in cfg["signals"]):
+                    return pool
+        return next(reversed(self.pools))
+
+    def _slack_pool(self, fleet) -> Optional[str]:
+        """The pool with the most removable slack: live count above its
+        own floor, >= 2 healthy members (never drain a pool to an
+        unserved role mid-heal).  None when no pool can shrink."""
+        best, best_slack = None, 0
+        for pool, cfg in self.pools.items():
+            live = self._pool_live(fleet, pool)
+            healthy = [r for r in live if r.state == HEALTHY]
+            slack = len(live) - cfg["min_replicas"]
+            if slack > best_slack and len(healthy) >= 2:
+                best, best_slack = pool, slack
+        return best
+
+    def _payload(self, fleet, action: str, live: int,
+                 pool: Optional[str] = None) -> Dict[str, Any]:
         budget = (self.chip_budget if self.chip_budget is not None
                   else fleet.chip_capacity())
-        return dict(
+        cfg = self.pools.get(pool) if pool is not None else None
+        payload = dict(
             action=action,
             replicas=live,
             delta=1,
-            min_replicas=self.min_replicas,
-            max_replicas=self.max_replicas,
+            min_replicas=(cfg["min_replicas"] if cfg
+                          else self.min_replicas),
+            max_replicas=(cfg["max_replicas"] if cfg
+                          else self.max_replicas),
             chips_required=self.replica_chips,
             chips_free=max(budget - fleet.chips_in_use(), 0),
         )
+        if pool is not None:
+            payload["pool"] = pool
+        return payload
 
     # --- the decision loop --------------------------------------------------
     def _record(self, kind: str, tick: int, **extra) -> None:
@@ -195,27 +297,51 @@ class FleetAutoscaler:
             # a drain is still in flight; one mutation at a time
             return None
         if burn >= self.up_streak:
-            return self._try_scale_up(fleet, len(live))
-        healthy = [r for r in live if r.state == HEALTHY]
-        if (self._slack_streak >= self.down_streak
-                and len(live) > self.min_replicas
-                # a sick/dead replica mid-heal is not removable slack:
-                # with < 2 healthy replicas the victim would be the
-                # last one serving
-                and len(healthy) >= 2):
-            return self._try_scale_down(fleet, live)
+            pool = self._burn_pool(fleet)
+            count = (len(self._pool_live(fleet, pool))
+                     if pool is not None else len(live))
+            return self._try_scale_up(fleet, count, pool=pool)
+        if self._slack_streak >= self.down_streak:
+            if self.pools:
+                pool = self._slack_pool(fleet)
+                if pool is None:
+                    return None
+                return self._try_scale_down(
+                    fleet, self._pool_live(fleet, pool), pool=pool)
+            healthy = [r for r in live if r.state == HEALTHY]
+            if (len(live) > self.min_replicas
+                    # a sick/dead replica mid-heal is not removable
+                    # slack: with < 2 healthy replicas the victim
+                    # would be the last one serving
+                    and len(healthy) >= 2):
+                return self._try_scale_down(fleet, live)
         return None
 
     # --- execution ----------------------------------------------------------
-    def _try_scale_up(self, fleet, live: int) -> Optional[str]:
+    def _role_spec(self, fleet, pool: Optional[str]
+                   ) -> Optional[Dict[str, Any]]:
+        """The replica spec a per-pool add builds with: the fleet's
+        own ``role_spec`` (pool kwargs + device placement) when it has
+        one, a bare role tag otherwise.  None in monolithic mode —
+        ``add_replica`` then picks its own default spec."""
+        if pool is None:
+            return None
+        role_spec = getattr(fleet, "role_spec", None)
+        if callable(role_spec):
+            return role_spec(pool)
+        return dict(role=pool)
+
+    def _try_scale_up(self, fleet, live: int,
+                      pool: Optional[str] = None) -> Optional[str]:
         from ..analysis.plan_check import verify_scale_payload
 
         tracer = get_tracer()
-        payload = self._payload(fleet, "add", live)
+        payload = self._payload(fleet, "add", live, pool=pool)
         problems = verify_scale_payload(payload)
         if problems:
             self._reject(fleet, payload, problems, tracer)
             return SCALE_REJECTED
+        spec = self._role_spec(fleet, pool)
         self._arc_id += 1
         lane = None
         if tracer is not None:
@@ -223,16 +349,16 @@ class FleetAutoscaler:
             tracer.async_begin(
                 "fleet_scale", lane, self._arc_id,
                 {"action": "add", "tick": fleet.tick,
-                 "replicas": live, "burn_streak":
-                     self.burn_streak(fleet)},
+                 "replicas": live, "pool": pool or "",
+                 "burn_streak": self.burn_streak(fleet)},
             )
         try:
             if tracer is not None:
                 with tracer.span("fleet.scale_up", lane,
                                  {"replicas": live}):
-                    replica = fleet.add_replica()
+                    replica = fleet.add_replica(spec)
             else:
-                replica = fleet.add_replica()
+                replica = fleet.add_replica(spec)
         except Exception as exc:
             # the verified build said no (slab allocation, serving
             # pre-flight): structural rollback already happened inside
@@ -245,7 +371,7 @@ class FleetAutoscaler:
             return SCALE_REJECTED
         fleet.stats.scale_ups += 1
         self._record(SCALE_UP, fleet.tick, replica=replica.name,
-                     replicas=live + 1)
+                     replicas=live + 1, pool=pool or "")
         self._cooldown_until = fleet.tick + self.cooldown_ticks
         self._slack_streak = 0
         self._logger.info(
@@ -258,10 +384,14 @@ class FleetAutoscaler:
                               "replica": replica.name})
         return SCALE_UP
 
-    def _pick_victim(self, live: List[Any]) -> Optional[Any]:
+    def _pick_victim(self, live: List[Any],
+                     pool: Optional[str] = None) -> Optional[Any]:
         """Least-loaded HEALTHY replica (cheapest drain); newest wins
-        ties so long-lived replicas keep their warmed caches."""
-        healthy = [r for r in live if r.state == HEALTHY]
+        ties so long-lived replicas keep their warmed caches.  With a
+        pool, only that role's members are candidates."""
+        healthy = [r for r in live if r.state == HEALTHY
+                   and (pool is None
+                        or getattr(r, "role", "") == pool)]
         if not healthy:
             return None
         return min(
@@ -270,16 +400,17 @@ class FleetAutoscaler:
                            + r.engine.stats.queue_depth),
         )
 
-    def _try_scale_down(self, fleet, live: List[Any]) -> Optional[str]:
+    def _try_scale_down(self, fleet, live: List[Any],
+                        pool: Optional[str] = None) -> Optional[str]:
         from ..analysis.plan_check import verify_scale_payload
 
         tracer = get_tracer()
-        payload = self._payload(fleet, "remove", len(live))
+        payload = self._payload(fleet, "remove", len(live), pool=pool)
         problems = verify_scale_payload(payload)
         if problems:
             self._reject(fleet, payload, problems, tracer)
             return SCALE_REJECTED
-        victim = self._pick_victim(live)
+        victim = self._pick_victim(live, pool=pool)
         if victim is None:
             return None
         self._arc_id += 1
@@ -311,7 +442,8 @@ class FleetAutoscaler:
             return SCALE_REJECTED
         fleet.stats.scale_downs += 1
         self._record(SCALE_DOWN, fleet.tick, replica=victim.name,
-                     replicas=len(live) - 1, drain=outcome)
+                     replicas=len(live) - 1, drain=outcome,
+                     pool=pool or "")
         self._cooldown_until = fleet.tick + self.cooldown_ticks
         self._slack_streak = 0
         self._logger.info(
@@ -328,6 +460,7 @@ class FleetAutoscaler:
 
 __all__ = [
     "FleetAutoscaler",
+    "POOL_SIGNALS",
     "SCALE_DOWN",
     "SCALE_REJECTED",
     "SCALE_UP",

@@ -224,7 +224,8 @@ class ServingFleet(LiveMetricsMixin):
         if not replica_specs:
             raise ValueError("a fleet needs at least one replica")
         self.replicas: List[EngineReplica] = [
-            EngineReplica(f"replica{i}", self._make_builder(spec))
+            EngineReplica(f"replica{i}", self._make_builder(spec),
+                          role=str(spec.get("role", "")))
             for i, spec in enumerate(replica_specs)
         ]
         self._by_name = {r.name: r for r in self.replicas}
@@ -296,6 +297,10 @@ class ServingFleet(LiveMetricsMixin):
         callable both boot and every later re-form/scale-up run."""
         merged = dict(self._shared_kwargs)
         merged.update(spec)
+        # "role" is replica metadata (disaggregated pool membership),
+        # not an engine knob — it rides the spec so scale-ups re-form
+        # into the right pool, but never reaches the engine ctor
+        merged.pop("role", None)
 
         def build() -> ServingEngine:
             return ServingEngine(self._model_cfg, self._params_list,
@@ -422,7 +427,8 @@ class ServingFleet(LiveMetricsMixin):
             ])
         name = f"replica{self._replica_seq}"
         replica = EngineReplica(name, self._make_builder(spec),
-                                defer_build=True)
+                                defer_build=True,
+                                role=str(spec.get("role", "")))
         self.replicas.append(replica)
         self._by_name[name] = replica
         self._specs[name] = dict(spec)
@@ -540,13 +546,7 @@ class ServingFleet(LiveMetricsMixin):
                     {"request": request.request_id,
                      "priority": priority},
                 )
-        decision = self.admission.decide(
-            pending=self._pending_depth(),
-            capacity_slots=self._capacity_slots(),
-            priority=priority,
-            deadline_s=deadline_s,
-            tpot_p50_s=self._window_percentile(self._tpot_window, 50),
-        )
+        decision = self._admit_decision(priority, deadline_s)
         if not decision.admitted:
             self._reject(request, decision, tracer)
             return decision
@@ -554,7 +554,8 @@ class ServingFleet(LiveMetricsMixin):
         # must not pay the per-replica snapshot walk for nothing
         snaps = self.replica_snapshots()
         try:
-            name = self._dispatch(request, snaps, deadline_s)
+            name = self._dispatch(request, snaps, deadline_s,
+                                  role=self._dispatch_role(request))
         except QueueFullError as exc:
             decision = AdmitDecision(
                 False, reason=REPLICAS_SATURATED,
@@ -601,14 +602,38 @@ class ServingFleet(LiveMetricsMixin):
                 )
             tracer.release_request_lane(request.request_id)
 
+    def _admit_decision(self, priority: str,
+                        deadline_s: Optional[float]) -> AdmitDecision:
+        """The front-door admission verdict for one submit.  A hook so
+        disaggregated fleets can gate each pool's controller separately
+        (per-pool pending/capacity) while :meth:`submit` stays the one
+        tracing/accounting path."""
+        return self.admission.decide(
+            pending=self._pending_depth(),
+            capacity_slots=self._capacity_slots(),
+            priority=priority,
+            deadline_s=deadline_s,
+            tpot_p50_s=self._window_percentile(self._tpot_window, 50),
+        )
+
+    def _dispatch_role(self, request: Request) -> Optional[str]:
+        """The pool a request should route to — None on monolithic
+        fleets (every replica competes).  Disaggregated fleets override
+        this: fresh work goes to the prefill pool, work with committed
+        tokens (a handoff fallback, a migrated decode) to the decode
+        pool."""
+        return None
+
     def _dispatch(self, request: Request,
                   snaps: Sequence[Dict[str, Any]],
-                  deadline_s: Optional[float]) -> str:
+                  deadline_s: Optional[float],
+                  role: Optional[str] = None) -> str:
         """Walk the router's ranking until a replica's bounded queue
         accepts, under the caller's total deadline (the ``retry_call``
         budget): a saturated-or-dying fleet must give up within the
         request's patience, not after an unbounded crawl."""
-        ranked = self.router.rank(snaps, prompt=request.prompt)
+        ranked = self.router.rank(snaps, prompt=request.prompt,
+                                  role=role)
         if not ranked:  # admission already gates on capacity; belt+braces
             raise QueueFullError("no healthy replica", 0)
         tracer = get_tracer()
@@ -732,7 +757,8 @@ class ServingFleet(LiveMetricsMixin):
 
     def _redispatch_one(self, request: Request) -> str:
         snaps = self.replica_snapshots()
-        ranked = self.router.rank(snaps, prompt=request.prompt)
+        ranked = self.router.rank(snaps, prompt=request.prompt,
+                                  role=self._dispatch_role(request))
         infeasible = 0
         for name in ranked:
             rep = self._by_name[name]

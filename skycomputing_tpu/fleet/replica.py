@@ -47,9 +47,15 @@ class EngineReplica:
 
     def __init__(self, name: str,
                  build_engine: Callable[[], ServingEngine],
-                 *, defer_build: bool = False):
+                 *, defer_build: bool = False, role: str = ""):
         self.name = str(name)
         self._build = build_engine
+        # pool role for disaggregated serving ("prefill" / "decode";
+        # empty = the monolithic default, routable for any work).  A
+        # label, not behavior: the engine spec behind build_engine is
+        # what actually specializes the replica — the role just makes
+        # that specialization visible to the router and autoscaler.
+        self.role = str(role)
         if defer_build:
             # a PROVISIONAL replica (fleet scale-up): no engine yet,
             # parked DEAD so the only way it can ever serve is through
@@ -162,6 +168,7 @@ class EngineReplica:
             # provisional replica mid-scale-up: visible, never routable
             return dict(name=self.name, healthy=False,
                         state=self.state, generation=self.generation,
+                        role=self.role,
                         slots=0, free_slots=0, queue_depth=0,
                         running=0, ttft_p95_s=None, tpot_p50_s=None,
                         tpot_p95_s=None)
@@ -174,6 +181,7 @@ class EngineReplica:
             healthy=self.serving and not self.crashed,
             state=self.state,
             generation=self.generation,
+            role=self.role,
             slots=self.engine.num_slots,
             free_slots=pool.free_slots,
             queue_depth=self.engine.stats.queue_depth,

@@ -130,16 +130,26 @@ class Router:
 
     # --- ranking -----------------------------------------------------------
     def rank(self, snapshots: Sequence[Dict[str, Any]],
-             prompt: Optional[Sequence[int]] = None) -> List[str]:
+             prompt: Optional[Sequence[int]] = None,
+             role: Optional[str] = None) -> List[str]:
         """Replica names, best dispatch target first.
 
-        Only snapshots marked ``healthy`` participate.  With a prompt,
-        the learned affinity replica is promoted to the front while its
-        outstanding request count stays within ``affinity_slack``
-        requests of the least-loaded candidate.  The full ranking (not just the winner) lets the
+        Only snapshots marked ``healthy`` participate.  With ``role``
+        set (disaggregated fleets), only replicas carrying that role
+        compete — prefill work never lands on a decode specialist and
+        vice versa; role-less fleets pass None and rank everyone.  With
+        a prompt, the learned affinity replica is promoted to the front
+        while its outstanding request count stays within
+        ``affinity_slack`` requests of the least-loaded candidate — on
+        a paged fleet the key is the prompt's first full KV page, so
+        role-aware prefill placement follows page-aligned prefix
+        affinity.  The full ranking (not just the winner) lets the
         fleet walk the list when the best target's bounded queue
         rejects."""
         healthy = [s for s in snapshots if s.get("healthy")]
+        if role is not None:
+            healthy = [s for s in healthy
+                       if str(s.get("role", "")) == str(role)]
         if not healthy:
             return []
         pace = _typical_pace(healthy)
@@ -166,10 +176,11 @@ class Router:
         return names
 
     def choose(self, snapshots: Sequence[Dict[str, Any]],
-               prompt: Optional[Sequence[int]] = None) -> Optional[str]:
+               prompt: Optional[Sequence[int]] = None,
+               role: Optional[str] = None) -> Optional[str]:
         """The single best dispatch target, or None with no healthy
-        replica."""
-        ranked = self.rank(snapshots, prompt)
+        replica (in the requested role, when one is given)."""
+        ranked = self.rank(snapshots, prompt, role=role)
         return ranked[0] if ranked else None
 
     # --- affinity bookkeeping ----------------------------------------------

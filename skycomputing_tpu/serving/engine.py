@@ -207,6 +207,16 @@ class ServingStats:
     draft_tokens: int = 0
     accepted_draft_tokens: int = 0
     spec_rollbacks: int = 0
+    # disaggregated-serving accounting (the prefill/decode handoff
+    # plane, docs/disagg.md): handoffs_out counts swap records exported
+    # as portable handoffs, handoffs_in records seated for swap-in
+    # resume on this engine, handoff_failures records refused at the
+    # import checksum gate (the request recomputes from its prompt —
+    # counted, never lost), handoff_bytes the host payload moved
+    handoffs_out: int = 0
+    handoffs_in: int = 0
+    handoff_failures: int = 0
+    handoff_bytes: int = 0
     # gauges
     queue_depth: int = 0
     batch_occupancy: float = 0.0
@@ -243,6 +253,8 @@ class ServingStats:
         "draft_tokens": "counter",
         "accepted_draft_tokens": "counter",
         "spec_rollbacks": "counter",
+        "handoffs_out": "counter", "handoffs_in": "counter",
+        "handoff_failures": "counter", "handoff_bytes": "counter",
         "queue_depth": "gauge", "batch_occupancy": "gauge",
         "pages_in_use": "gauge", "free_pages": "gauge",
         "tokens_per_s": "gauge",
@@ -292,6 +304,10 @@ class ServingStats:
             draft_tokens=self.draft_tokens,
             accepted_draft_tokens=self.accepted_draft_tokens,
             spec_rollbacks=self.spec_rollbacks,
+            handoffs_out=self.handoffs_out,
+            handoffs_in=self.handoffs_in,
+            handoff_failures=self.handoff_failures,
+            handoff_bytes=self.handoff_bytes,
             queue_depth=self.queue_depth,
             batch_occupancy=self.batch_occupancy,
             pages_in_use=self.pages_in_use,
@@ -599,6 +615,51 @@ def _swap_record_checksum(pages: int, index: int,
 
     fold(data)
     return h.hexdigest()
+
+
+def _swap_record_nbytes(data: List[Any]) -> int:
+    """Total host bytes a swap record parks (the payload a handoff
+    moves between pools — ``handoff_bytes`` accounting)."""
+    total = 0
+
+    def fold(host) -> None:
+        nonlocal total
+        if isinstance(host, QuantizedPages):
+            fold(host.values)
+            fold(host.scale)
+            return
+        if isinstance(host, (list, tuple)):
+            for item in host:
+                fold(item)
+            return
+        total += int(np.ascontiguousarray(host).nbytes)
+
+    fold(data)
+    return total
+
+
+def _stage_slab_checksums(data: List[Any]) -> List[str]:
+    """One sha256 per stage's host slabs (same leaf fold as
+    ``_swap_record_checksum``) — a corrupted handoff names the stage
+    instead of just failing the whole record."""
+    out = []
+    for stage_pairs in data:
+        h = hashlib.sha256()
+
+        def fold(host) -> None:
+            if isinstance(host, QuantizedPages):
+                fold(host.values)
+                fold(host.scale)
+                return
+            if isinstance(host, (list, tuple)):
+                for item in host:
+                    fold(item)
+                return
+            h.update(np.ascontiguousarray(host).tobytes())
+
+        fold(stage_pairs)
+        out.append(h.hexdigest())
+    return out
 
 
 class ServingEngine(LiveMetricsMixin):
@@ -1525,6 +1586,140 @@ class ServingEngine(LiveMetricsMixin):
             k_host = bad
         pairs[0] = (k_host, v_host)
         return rid
+
+    # --- the disaggregated prefill/decode handoff plane ---------------------
+    def export_handoff(self, request_id: int) -> tuple:
+        """Detach a decoding request as a portable handoff: the request
+        (token stream intact) plus its swap record (host page copies +
+        checksum), ready for another engine's :meth:`import_handoff`.
+
+        Rides the public preempt path in ``swap`` mode verbatim — same
+        host copies, same checksum stamp, same fixed gather shape — so
+        a handoff export counts as a preemption + swap-out in the
+        stats, and the record popped here is byte-identical to what a
+        local swap-in would have restored.  Only a request PAST prefill
+        can export (its first token is seeded and its KV watermark is
+        page-complete); mid-prefill requests raise, exactly as
+        ``preempt(mode="swap")`` does.  The caller (the disagg pool
+        front door) owns delivering the pair and conserving it in a
+        ledger — after this returns, this engine holds NO state for the
+        request."""
+        if not self._paged:
+            raise ValueError(
+                "handoff export needs a paged engine (swap records are "
+                "the carrier)"
+            )
+        request = self._running.get(request_id)
+        if request is None:
+            raise KeyError(
+                f"request {request_id} is not decoding here"
+            )
+        if not request.tokens:
+            raise ValueError(
+                "a request hands off only after prefill seeded its "
+                "first token"
+            )
+        if request.done:
+            raise ValueError(
+                "a finished request has nothing left to hand off"
+            )
+        self.preempt(request_id, mode="swap")
+        record = self._swapped.pop(request_id)
+        self._queue.remove(request)
+        self.stats.queue_depth = self._queue.depth
+        self.stats.handoffs_out += 1
+        self.stats.handoff_bytes += _swap_record_nbytes(record["data"])
+        tracer = get_tracer()
+        if tracer is not None:
+            tracer.instant(
+                "handoff_out", tracer.lane("serving", "engine"),
+                {"request": request_id, "pages": record["pages"]},
+            )
+            # the queue segment preempt just opened ends here: the
+            # request leaves this engine entirely (the importing side
+            # opens its own)
+            self._trace_close_queue(request, tracer, drained=True)
+        return request, record
+
+    def import_handoff(self, request: Request, record: dict) -> bool:
+        """Seat an exported handoff for swap-in resume — checksum
+        verified FIRST, before the record touches any engine state.
+
+        True: the record passed its integrity gate and is parked; the
+        admission loop's existing swap-in path (``_admit_paged`` →
+        ``_swap_in``) restores the pages with NO prefill and decoding
+        continues at the record's index — the resume path IS the
+        swap-in path, no new compile shapes.  False: the checksum did
+        not match (or the payload shape cannot fit this engine), the
+        poisoned record is refused, ``handoff_failures`` counts it, and
+        the request re-queues to recompute from its prompt — committed
+        tokens intact, so the stream is exact either way.  A corrupt
+        record whose resume prefix fits no bucket is FAILED with a
+        reasoned verdict, mirroring ``_swap_in``'s corruption verdict.
+        """
+        if not self._paged:
+            raise ValueError(
+                "handoff import needs a paged engine (swap records are "
+                "the carrier)"
+            )
+        rid = request.request_id
+        if (rid in self._running or rid in self._prefilling
+                or rid in self._swapped
+                or any(r is request for r in self._queue.requests)):
+            raise ValueError(
+                f"request {rid} is already live on this engine"
+            )
+        pages = record.get("pages")
+        index = record.get("index")
+        data = record.get("data")
+        ok = (
+            isinstance(pages, int) and 1 <= pages
+            and pages <= self.max_pages_per_request
+            and isinstance(index, int) and index >= 1
+            and isinstance(data, list) and len(data) == len(self.stages)
+        )
+        if ok:
+            expect = record.get("checksum")
+            ok = (expect is not None
+                  and _swap_record_checksum(pages, index, data)
+                  == expect)
+        tracer = get_tracer()
+        if ok:
+            self._swapped[rid] = record
+            # bytes were counted once at export — the exporting side
+            # owns the payload accounting, so a fleet-level sum over
+            # both pools counts each handoff's bytes exactly once
+            self.stats.handoffs_in += 1
+        else:
+            self.stats.handoff_failures += 1
+            if tracer is not None:
+                tracer.instant(
+                    "handoff_corrupt", tracer.lane("serving", "engine"),
+                    {"request": rid},
+                )
+            try:
+                self.bucketer.bucket_for(
+                    int(request.effective_prompt.size)
+                )
+            except ValueError:
+                request.status = FAILED
+                request.fail_reason = (
+                    "handoff record corrupted and the resume prefix "
+                    "fits no bucket"
+                )
+                return False
+        # force: the handoff was admitted on the exporting pool — the
+        # promise survives the pool boundary; a verified record resumes
+        # bucket-free (swap-in), a refused one re-buckets to recompute
+        self._queue.submit(request, force=True, require_bucket=not ok)
+        self.stats.queue_depth = self._queue.depth
+        if tracer is not None:
+            tracer.instant(
+                "handoff_in", tracer.lane("serving", "engine"),
+                {"request": rid, "verified": ok},
+            )
+        self._trace_queued(request, tracer)
+        return ok
 
     @property
     def running_requests(self) -> List[Request]:

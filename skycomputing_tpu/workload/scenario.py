@@ -428,6 +428,9 @@ class Scenario:
 #   radix prefix reuse are actually for.
 # - ``length_skew`` — adversarial heavy-tailed prompt lengths; what
 #   chunked prefill and bucket padding discipline are actually for.
+# - ``disagg_mix`` — alternating long-prompt/short-decode and
+#   short-prompt/long-decode regimes; the workload disaggregated
+#   prefill/decode pools (and their per-pool autoscaling) are for.
 #
 # Sizing contract: defaults are sized for this repo's CPU bench harness
 # (tiny GPT, buckets up to 96, ~2 decode slots per replica ≈ 0.1
@@ -603,6 +606,38 @@ def length_skew(seed: int = 0, rate_scale: float = 1.0,
                     "thin band of near-bucket-limit giants",
     )
 
+
+@register_scenario("disagg_mix")
+def disagg_mix(seed: int = 0, rate_scale: float = 1.0,
+               ticks_scale: float = 1.0) -> Scenario:
+    # disaggregation's home turf: phases where the BOTTLENECK PHASE
+    # flips — long-prompt/short-decode waves (prefill-bound: summarize,
+    # classify) interleaved with short-prompt/long-decode streams
+    # (decode-bound: chat) — so a monolithic pool thrashes between
+    # operating points while role pools each stay on theirs
+    def phase(name, ticks, rate, prompt, new, mix):
+        return Phase(name=name, ticks=_ticks(ticks, ticks_scale),
+                     arrival_rate=rate * rate_scale,
+                     prompt_len=prompt, new_tokens=new,
+                     priority_mix=mix)
+
+    return Scenario(
+        name="disagg_mix", seed=seed,
+        phases=(
+            phase("ingest_wave", 50, 0.14,
+                  Dist.uniform(40, 80), Dist.uniform(4, 8),
+                  ((INTERACTIVE, 0.3), (BATCH, 0.7))),
+            phase("mixed", 40, 0.16,
+                  Dist.uniform(12, 48), Dist.uniform(8, 16),
+                  ((INTERACTIVE, 0.5), (BATCH, 0.5))),
+            phase("chat_stream", 50, 0.14,
+                  Dist.uniform(6, 16), Dist.uniform(20, 32),
+                  ((INTERACTIVE, 0.7), (BATCH, 0.3))),
+        ),
+        description="long-prompt/short-decode waves interleaved with "
+                    "short-prompt/long-decode streams; the disaggregated "
+                    "prefill/decode acceptance workload",
+    )
 
 
 __all__ = [

@@ -207,12 +207,13 @@ def test_digest_scopes_identity_seed_and_schedule():
 
 
 def test_catalog_names_pairing_and_replay():
-    """The six documented campaigns, in order, each paired with a REAL
-    workload-catalog scenario, each byte-replayable; unknown names fail
-    with the catalog in the message."""
+    """The seven documented campaigns, in order, each paired with a
+    REAL workload-catalog scenario, each byte-replayable; unknown names
+    fail with the catalog in the message."""
     assert fault_plan_names() == [
         "replica_crash_storm", "rolling_stragglers", "mid_drain_kill",
         "swap_corruption", "reform_flap", "overload_then_crash",
+        "prefill_kill_mid_handoff",
     ]
     for name in fault_plan_names():
         plan = get_fault_plan(name, seed=3)

@@ -1,0 +1,438 @@
+"""Disaggregated-serving contracts (CPU-deterministic, tier-1).
+
+The disagg plane splits one fleet into a prefill pool and a decode pool
+joined by the checksummed KV-handoff plane (``disagg/handoff.py`` +
+``disagg/pools.py``).  Its correctness story extends the fleet's
+token-identity invariant across the pool gap: every request the fleet
+accepted and finished must equal the one-shot ``generate`` for its
+prompt — through a handoff, through a corrupted handoff's
+recompute-from-prompt fallback, through a prefill replica dying with
+records in flight, and through per-role scale events.  The robustness
+story is the ledger's conservation invariant: every handoff ends in
+exactly one of {pending, delivered, failed-with-reason}, and both
+pools' front doors reject with the pool named in the verdict.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from skycomputing_tpu.builder import build_layer_stack
+from skycomputing_tpu.disagg import (
+    DECODE,
+    PREFILL,
+    DisaggFleet,
+    HandoffLedger,
+    HandoffRecord,
+)
+from skycomputing_tpu.disagg.handoff import DELIVERED, FAILED, PENDING
+from skycomputing_tpu.fleet import (
+    AdmissionController,
+    FleetAutoscaler,
+    FleetSupervisor,
+    ServingFleet,
+)
+from skycomputing_tpu.models.gpt import (
+    GptConfig,
+    generate,
+    gpt_layer_configs,
+)
+from skycomputing_tpu.serving import Request
+
+pytestmark = pytest.mark.disagg
+
+_HEX = "ab" * 32
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    """Tiny GPT + host params + jitted one-shot forward reference
+    (the test_fleet fixture, shared by every disagg scenario)."""
+    cfg = GptConfig(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=2, max_position_embeddings=64,
+                    dropout_prob=0.0, dtype="float32")
+    layer_cfgs = gpt_layer_configs(cfg, deterministic=True)
+    stack = build_layer_stack(layer_cfgs)
+    params = stack.init(jax.random.key(7), np.ones((1, 5), np.int32))
+    fwd = jax.jit(lambda ids: stack.apply(params, ids))
+    return layer_cfgs, params, fwd
+
+
+def reference(fwd, request):
+    out = generate(fwd, request.prompt[None],
+                   max_new_tokens=request.max_new_tokens,
+                   context_length=64)
+    return out[0]
+
+
+def paged_kwargs(**over):
+    kwargs = dict(num_slots=2, max_len=48, buckets=(8, 16, 32),
+                  kv_layout="paged", page_size=8, max_concurrency=6)
+    kwargs.update(over)
+    return kwargs
+
+
+def fast_supervisor(**kw):
+    defaults = dict(check_every=1, heartbeat_misses=1, grace_ticks=2,
+                    baseline_ticks=3, k_checks=2, sick_threshold=1e9)
+    defaults.update(kw)
+    return FleetSupervisor(**defaults)
+
+
+def make_disagg(gpt, devices, *, prefill=1, decode=1, **kw):
+    layer_cfgs, params, _ = gpt
+    return DisaggFleet(
+        layer_cfgs, params,
+        prefill_replicas=prefill, decode_replicas=decode,
+        engine_kwargs=paged_kwargs(),
+        supervisor=fast_supervisor(),
+        devices=devices,
+        **kw,
+    )
+
+
+def mixed_requests(rng, specs):
+    return [
+        Request(prompt=rng.integers(1, 512, (l,)).astype(np.int32),
+                max_new_tokens=n)
+        for l, n in specs
+    ]
+
+
+def record(rid=0, **over):
+    fields = dict(
+        request_id=rid, source="replica0", prompt_len=8,
+        prefilled_len=9, index=9, pages=2, checksum=_HEX,
+        slab_checksums=(_HEX, _HEX), page_size=8,
+        max_pages_per_request=4, stages=2, kv_dtype="float32", tick=3,
+    )
+    fields.update(over)
+    return HandoffRecord(**fields)
+
+
+# ---------------------------------------------------------------------------
+# the handoff contract (pure host logic, no engines)
+# ---------------------------------------------------------------------------
+
+
+def test_handoff_record_rejects_malformed_fields():
+    """Every class of malformed record dies at construction — a bad
+    record must never reach a ledger, let alone a decode engine."""
+    record()  # the well-formed baseline constructs
+    negatives = (
+        dict(request_id=-1),
+        dict(source=""),
+        dict(prompt_len=0),
+        dict(prefilled_len=7),              # below the prompt length
+        dict(pages=9),                      # over max_pages_per_request
+        dict(index=99),                     # pages cannot cover index
+        dict(checksum="abc"),
+        dict(checksum=_HEX.upper()),        # digests are lowercase hex
+        dict(slab_checksums=(_HEX,)),       # one digest per stage
+        dict(slab_checksums=[_HEX, _HEX]),  # tuple, not list
+        dict(kv_dtype=""),
+        dict(tick=-2),
+    )
+    for over in negatives:
+        with pytest.raises(ValueError):
+            record(**over)
+
+
+def test_handoff_ledger_state_machine_and_conservation():
+    """pending -> delivered, pending|delivered -> failed-with-reason,
+    nothing else; the audit partitions every record into exactly one
+    state and carries every failure's reason."""
+    led = HandoffLedger()
+    with pytest.raises(ValueError):
+        led.enqueue("not a record")
+    for rid, src in ((1, "replica0"), (2, "replica0"), (3, "replica1")):
+        led.enqueue(record(rid=rid, source=src))
+    with pytest.raises(ValueError):
+        led.enqueue(record(rid=1))  # a request hands off at most once
+    assert led.state_of(1) == PENDING and led.state_of(99) is None
+    with pytest.raises(ValueError):
+        led.mark_failed(1, "")  # a failure without a reason is refused
+    led.mark_delivered(1, target="replica2")
+    assert led.state_of(1) == DELIVERED
+    with pytest.raises(ValueError):
+        led.mark_delivered(1)
+    led.mark_failed(2, "source died mid-handoff")
+    assert led.state_of(2) == FAILED
+    with pytest.raises(ValueError):
+        led.mark_failed(2, "again")  # failed is final
+    # dead-source query: the records a crashed prefill replica strands
+    assert [r.request_id for r in led.pending_for("replica1")] == [3]
+    assert led.pending_for("replica0") == []
+    audit = led.audit()
+    assert audit["conservation_ok"]
+    assert (audit["total"], audit["pending"], audit["delivered"],
+            audit["failed"]) == (3, 1, 1, 1)
+    assert audit["failed_reasons"] == {"source died mid-handoff": 1}
+    assert led.snapshot() == dict(
+        handoffs_enqueued=3, handoffs_delivered=1,
+        handoffs_failed=1, handoffs_pending=1,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the pool gap: token identity across the handoff plane
+# ---------------------------------------------------------------------------
+
+
+def test_disagg_token_identical_to_monolithic(gpt, devices):
+    """The same requests through a disaggregated fleet and a monolithic
+    one at the same chip count: every stream equals the one-shot
+    ``generate`` reference AND the monolith's stream — the pool split
+    changes the schedule, never the math — and every finished request
+    crossed the handoff plane exactly once."""
+    layer_cfgs, params, fwd = gpt
+    rng = np.random.default_rng(11)
+    specs = [(5, 9), (3, 4), (12, 7), (7, 5), (16, 6), (2, 8), (9, 6)]
+
+    mono = ServingFleet(
+        layer_cfgs, params, replicas=2,
+        engine_kwargs=paged_kwargs(),
+        supervisor=fast_supervisor(),
+        devices=devices,
+    )
+    mono_reqs = mixed_requests(rng, specs)
+    mono_out = mono.run(mono_reqs)
+
+    dis = make_disagg(gpt, devices, prefill=1, decode=1)
+    dis_reqs = [Request(prompt=r.prompt.copy(),
+                        max_new_tokens=r.max_new_tokens)
+                for r in mono_reqs]
+    dis_out = dis.run(dis_reqs)
+
+    assert len(dis_out) == len(specs)
+    for m, d in zip(mono_reqs, dis_reqs):
+        ref = reference(fwd, m)
+        np.testing.assert_array_equal(mono_out[m.request_id], ref)
+        np.testing.assert_array_equal(dis_out[d.request_id], ref)
+    assert dis.stats.failed == 0
+
+    audit = dis.ledger.audit()
+    assert audit["conservation_ok"] and audit["pending"] == 0
+    assert audit["delivered_total"] == len(specs)
+    assert audit["failed_total"] == 0
+    # counter discipline across the plane: prefill exported what the
+    # decode pool seated, and the payload bytes were counted
+    snap = dis.metrics.snapshot()
+    out_total = sum(s.get("handoffs_out", 0)
+                    for n, s in snap.items() if n != "fleet")
+    in_total = sum(s.get("handoffs_in", 0)
+                   for n, s in snap.items() if n != "fleet")
+    bytes_total = sum(s.get("handoff_bytes", 0)
+                      for n, s in snap.items() if n != "fleet")
+    assert out_total == len(specs) and in_total == len(specs)
+    assert bytes_total > 0
+
+
+def test_checksum_mismatch_falls_back_to_recompute(gpt, devices):
+    """Corrupt a handoff payload mid-flight: the decode pool's import
+    verifies digests FIRST, refuses the poisoned KV, and the request
+    recomputes from its prompt — counted in the ledger with a reason
+    and on the decode engine's ``handoff_failures``, never lost, and
+    still token-identical."""
+    layer_cfgs, params, fwd = gpt
+    fleet = make_disagg(gpt, devices, prefill=1, decode=1)
+    rng = np.random.default_rng(3)
+    requests = mixed_requests(rng, [(6, 7), (10, 5), (4, 8)])
+    for r in requests:
+        assert fleet.submit(r).admitted
+    # step until a record is actually in flight, then poison it
+    for _ in range(64):
+        fleet.step()
+        if fleet.ledger.pending():
+            break
+    assert fleet.ledger.pending(), "no handoff entered the window"
+    rid = fleet.corrupt_handoff()
+    assert rid is not None
+    while fleet.has_work():
+        fleet.step()
+    for r in requests:
+        np.testing.assert_array_equal(r.output(), reference(fwd, r))
+    audit = fleet.ledger.audit()
+    assert audit["conservation_ok"] and audit["pending"] == 0
+    assert audit["failed_total"] == 1
+    assert audit["failed_reasons"] == {
+        "checksum mismatch at import; recomputing from prompt": 1
+    }
+    assert audit["delivered_total"] == len(requests) - 1
+    decode_engine = fleet.pool_replicas(DECODE)[0].engine
+    assert decode_engine.stats.handoff_failures == 1
+    assert fleet.stats.failed == 0
+
+
+def test_dead_prefill_replica_redispatches_inflight_handoffs(
+        gpt, devices):
+    """Kill the only prefill replica with records in flight: the
+    payloads are fleet-held, so the handoffs still deliver, the
+    replica's unexported work migrates, every request finishes
+    token-identical, and the ledger strands nothing."""
+    layer_cfgs, params, fwd = gpt
+    fleet = make_disagg(gpt, devices, prefill=1, decode=2)
+    rng = np.random.default_rng(9)
+    requests = mixed_requests(
+        rng, [(5, 8), (11, 6), (3, 9), (8, 5), (14, 7), (6, 6)]
+    )
+    for r in requests:
+        assert fleet.submit(r).admitted
+    prefill_replica = fleet.pool_replicas(PREFILL)[0]
+    for _ in range(64):
+        fleet.step()
+        if fleet.ledger.pending():
+            break
+    assert fleet.ledger.pending(), "no handoff entered the window"
+    assert fleet.pool_replicas(PREFILL)[0] is prefill_replica
+    prefill_replica.crash()
+    while fleet.has_work():
+        fleet.step()
+    for r in requests:
+        np.testing.assert_array_equal(r.output(), reference(fwd, r))
+    audit = fleet.ledger.audit()
+    assert audit["conservation_ok"] and audit["pending"] == 0
+    # whatever was in flight at the kill still reached the decode pool
+    assert audit["delivered_total"] >= 1
+    assert fleet.stats.failed == 0
+    assert fleet.stats.reforms >= 1
+    reformed = fleet.pool_replicas(PREFILL)[0]
+    assert reformed.generation >= 1 and reformed.role == PREFILL
+
+
+# ---------------------------------------------------------------------------
+# per-pool front doors
+# ---------------------------------------------------------------------------
+
+
+def test_per_pool_admission_rejection_names_its_pool(gpt, devices):
+    """Each pool's controller gates every submit; the binding rejection
+    carries the pool's name in the decision detail, a reason, and a
+    Retry-After hint — explicit degradation, per pool.  The decode
+    door counts undelivered handoffs as backlog, so a bound of 1 binds
+    as soon as one record is in flight."""
+    fleet = make_disagg(
+        gpt, devices, prefill=1, decode=1,
+        decode_admission=AdmissionController(max_pending=1),
+    )
+    rng = np.random.default_rng(17)
+    first = mixed_requests(rng, [(6, 10)] * 4)
+    for r in first:  # decode backlog is 0 at submit: all admitted
+        assert fleet.submit(r).admitted
+    for _ in range(64):
+        fleet.step()
+        if fleet.ledger.pending():
+            break
+    assert fleet.ledger.pending(), "no handoff entered the window"
+    late = mixed_requests(rng, [(6, 6)] * 2)
+    decisions = [fleet.submit(r) for r in late]
+    rejected = [d for d in decisions if not d.admitted]
+    assert rejected, "decode bound never bound"
+    for d in rejected:
+        assert d.detail["pool"] == DECODE
+        assert d.reason
+        assert d.retry_after_s > 0
+    assert fleet.stats.rejected == len(rejected)
+    assert (sum(fleet.stats.rejected_by_reason.values())
+            == len(rejected))
+
+    tight = make_disagg(
+        gpt, devices, prefill=1, decode=1,
+        prefill_admission=AdmissionController(max_pending=1),
+    )
+    decisions = [tight.submit(r) for r in
+                 mixed_requests(rng, [(6, 6)] * 10)]
+    rejected = [d for d in decisions if not d.admitted]
+    assert rejected, "prefill bound never bound"
+    assert all(d.detail["pool"] == PREFILL for d in rejected)
+    # the fleets still drain what they accepted
+    fleet.run()
+    tight.run()
+    assert fleet.stats.failed == 0 and tight.stats.failed == 0
+
+
+# ---------------------------------------------------------------------------
+# per-role autoscaling
+# ---------------------------------------------------------------------------
+
+
+class StubSlo:
+    """Duck-typed burn source (the test_autoscaler idiom): per-pool
+    attribution reads the firing target NAMES, so firing ``ttft_p95``
+    charges the burn to the prefill pool deterministically — the burn
+    evidence is a test INPUT, not a wall-clock emergent."""
+
+    def __init__(self):
+        self.firing = ()
+        self.firing_streak = 0
+        self.quiet_streak = 0
+
+    def burn(self, target="ttft_p95"):
+        self.firing = (target,)
+        self.firing_streak += 1
+        self.quiet_streak = 0
+
+    def clear(self):
+        self.firing = ()
+        self.firing_streak = 0
+
+    def evaluate(self, tracer=None):
+        return []
+
+
+def test_per_role_autoscaler_scales_the_burning_pool(gpt, devices):
+    """Per-pool mode E2E: TTFT-attributed burn grows the PREFILL pool
+    (the added replica carries the role and serves), sustained slack
+    drains it back, and every request served across both scale events
+    is token-identical."""
+    layer_cfgs, params, fwd = gpt
+    auto = FleetAutoscaler(
+        min_replicas=2, max_replicas=4,
+        up_streak=2, down_streak=4, cooldown_ticks=3,
+        chip_budget=8,
+        pools={
+            PREFILL: dict(min_replicas=1, max_replicas=2),
+            DECODE: dict(min_replicas=1, max_replicas=2),
+        },
+    )
+    fleet = make_disagg(gpt, devices, prefill=1, decode=1,
+                        autoscaler=auto)
+    fleet.slo = StubSlo()  # duck-typed; attach_slo needs a real monitor
+    rng = np.random.default_rng(21)
+    served = mixed_requests(rng, [(6, 5), (10, 4), (4, 6), (8, 5)])
+    # requests in flight while the TTFT burn earns a prefill replica
+    for r in served[:2]:
+        assert fleet.submit(r).admitted
+    for _ in range(3):
+        fleet.slo.burn("ttft_p95")
+        fleet.step()
+    assert fleet.stats.scale_ups == 1, auto.events
+    ups = [e for e in auto.events if e["kind"] == "scale_up"]
+    assert [e["pool"] for e in ups] == [PREFILL]
+    assert len(fleet.pool_replicas(PREFILL)) == 2
+    assert all(r.role == PREFILL for r in fleet.pool_replicas(PREFILL))
+    assert len(fleet.pool_replicas(DECODE)) == 1
+    # ...and while the quiet fleet drains the grown pool back down
+    for r in served[2:]:
+        assert fleet.submit(r).admitted
+    fleet.slo.clear()
+    while fleet.has_work():
+        fleet.step()
+    for _ in range(40):
+        fleet.step()
+        if fleet.stats.scale_downs >= 1:
+            break
+    assert fleet.stats.scale_downs == 1, auto.events
+    downs = [e for e in auto.events if e["kind"] == "scale_down"]
+    assert [e["pool"] for e in downs] == [PREFILL]
+    assert len(fleet.pool_replicas(PREFILL)) == 1
+    assert len(fleet.pool_replicas(DECODE)) == 1
+    for r in served:
+        assert r.status == "finished"
+        np.testing.assert_array_equal(r.output(), reference(fwd, r))
+    audit = fleet.ledger.audit()
+    assert audit["conservation_ok"] and audit["pending"] == 0
+    assert audit["delivered_total"] + audit["failed_total"] \
+        == len(served)
+    assert fleet.stats.failed == 0

@@ -115,7 +115,7 @@ def test_scenario_trace_determinism_digest_and_accumulator():
 def test_catalog_contracts():
     assert scenario_names() == [
         "diurnal_ramp", "flash_crowd", "tenant_mix",
-        "rag_shared_prefix", "length_skew",
+        "rag_shared_prefix", "length_skew", "disagg_mix",
     ]
     for name in scenario_names():
         sc = get_scenario(name)

@@ -102,6 +102,7 @@ def run_bench(plan_names, out: Optional[str], seed: int) -> int:
         get_fault_plan,
         make_probe,
     )
+    from skycomputing_tpu.disagg import DisaggFleet
     from skycomputing_tpu.fleet import (
         FleetAutoscaler,
         FleetSupervisor,
@@ -136,14 +137,28 @@ def run_bench(plan_names, out: Optional[str], seed: int) -> int:
                 up_streak=3, down_streak=6, cooldown_ticks=8,
                 slack_utilization=0.35,
             )
-        fleet = ServingFleet(
-            layer_cfgs, params, replicas=plan.replicas,
-            engine_kwargs=dict(engine_kwargs),
-            supervisor=FleetSupervisor(check_every=1,
-                                       heartbeat_misses=1,
-                                       sick_threshold=8.0, k_checks=3),
-            autoscaler=auto,
-        )
+        supervisor = FleetSupervisor(check_every=1,
+                                     heartbeat_misses=1,
+                                     sick_threshold=8.0, k_checks=3)
+        if plan.disagg:
+            # disagg campaigns run one prefill specialist plus
+            # replicas-1 decoders, so a plan's index:0 selector always
+            # names the prefill side (the kill-mid-handoff target)
+            fleet = DisaggFleet(
+                layer_cfgs, params,
+                prefill_replicas=1,
+                decode_replicas=plan.replicas - 1,
+                engine_kwargs=dict(engine_kwargs),
+                supervisor=supervisor,
+                autoscaler=auto,
+            )
+        else:
+            fleet = ServingFleet(
+                layer_cfgs, params, replicas=plan.replicas,
+                engine_kwargs=dict(engine_kwargs),
+                supervisor=supervisor,
+                autoscaler=auto,
+            )
         if auto is not None:
             # the autoscaler's burn signal (the bench_scenarios
             # queue_pressure target): without a monitor it can only

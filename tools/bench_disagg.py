@@ -1,0 +1,412 @@
+#!/usr/bin/env python
+"""Disaggregation bench: prefill/decode pools vs a monolithic fleet.
+
+The disagg plane (``skycomputing_tpu/disagg/``) splits a serving fleet
+into role-specialized pools joined by the checksummed KV-handoff plane;
+this bench is where that split earns its committed verdict
+(``BENCH_disagg.json``).  The acceptance scenario is ``disagg_mix`` —
+an ingest wave (long prompts, short answers), a mixed middle, a chat
+stream (short prompts, long answers) — replayed at EQUAL chips:
+
+- **monolithic**: ``ServingFleet`` with 4 single-device replicas on the
+  fleet's one compromise operating point, every replica both prefilling
+  and decoding.  Interference is the baseline's story: every decode
+  tick pays the per-engine dispatch of all 4 engines, and every engine
+  keeps slots parked under long-prompt admissions.
+- **disagg**: ``DisaggFleet`` with 3 prefill specialists (the same
+  operating point — their slots turn over at the FIRST token, when the
+  request exports as a checksummed handoff) and 1 decode specialist on
+  a role-tuned point (a deep slot ledger: ``num_slots=4`` -> a 16-row
+  decode slab, page budget to match) that verifies digests FIRST, then
+  seats KV on the engine's existing swap-in path.
+
+Both tails improve for structural reasons, not tuning luck: TTFT
+because prefill-pool slots free at the first token instead of being
+held through a full decode stream, and TPOT because the whole decode
+population batches onto ONE deep slab — one decode dispatch per tick
+where the monolith pays four.
+
+Method notes (what makes the verdict replayable): latency-threshold
+supervision is disabled (a wall-clock health probe would inject drains
+into a latency bench — the chaos bench owns that machinery), the
+garbage collector is paused during measured replays, and each topology
+is replayed 4x with the latency gates comparing the MINIMUM of the
+per-replay p95s.  The minimum is the right estimator here: the replay
+schedule is deterministic, so wall-clock differences between same-seed
+replays are pure host noise, and noise on a latency is strictly
+additive — the cleanest replay is the closest observation of each
+topology's true deterministic cost (the classic min-of-N bench rule).
+A throwaway replay of each topology first warms the process-global
+stage-program cache, so the measured zero-recompile gate checks steady
+state — and because the handoff import path is the swap-in path, the
+disagg run compiles nothing the warmed monolith + pool operating
+points did not already own.
+
+Gates, written into the artifact:
+
+- ``ttft_p95`` AND ``tpot_p95`` both improve at equal chips (noise
+  floors: min of 4 per-replay p95s);
+- zero lost or duplicated tokens in both topologies: every admitted
+  request finishes, every stream is token-identical to the one-shot
+  ``generate`` reference, and the disagg streams match the monolith's
+  request for request;
+- zero steady-state recompiles in BOTH topologies;
+- every finished disagg request crossed the handoff plane exactly once
+  and the ledger conserves all of them ({pending, delivered,
+  failed-with-reason} partition, nothing stranded after drain);
+- all runs saw the byte-identical arrival trace (digest equality), and
+  the 4 same-seed disagg replays are digest-equal, token-identical,
+  and ledger-identical — the split changes the schedule, never the
+  math.
+
+Usage::
+
+    python tools/bench_disagg.py --out BENCH_disagg.json
+    python tools/bench_disagg.py --rate-scale 3.0
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import os
+import sys
+import time
+from typing import Optional
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MONO_REPLICAS = 4
+PREFILL_REPLICAS = 3
+DECODE_REPLICAS = 1
+REPLAYS = 4
+
+
+def run_bench(out: Optional[str], seed: int, rate_scale: float,
+              epilogue: int) -> int:
+    if _ROOT not in sys.path:
+        sys.path.insert(0, _ROOT)
+    import jax
+    import numpy as np
+
+    from skycomputing_tpu.builder import build_layer_stack
+    from skycomputing_tpu.disagg import DisaggFleet
+    from skycomputing_tpu.fleet import FleetSupervisor, ServingFleet
+    from skycomputing_tpu.models.gpt import (
+        GptConfig,
+        generate,
+        gpt_layer_configs,
+    )
+    from skycomputing_tpu.serving import Request
+    from skycomputing_tpu.workload import ScenarioPlayer, get_scenario
+
+    scenario = get_scenario("disagg_mix", seed=seed,
+                            rate_scale=rate_scale)
+    cfg = GptConfig(vocab_size=512, hidden_size=64,
+                    num_hidden_layers=2, num_attention_heads=2,
+                    max_position_embeddings=160, dropout_prob=0.0,
+                    dtype="float32")
+    layer_cfgs = gpt_layer_configs(cfg, deterministic=True)
+    stack = build_layer_stack(layer_cfgs)
+    print(f"initializing {len(layer_cfgs)}-layer GPT "
+          f"(hidden={cfg.hidden_size})...", flush=True)
+    params = stack.init(jax.random.key(seed),
+                        np.ones((1, 8), np.int32))
+    fwd = jax.jit(lambda ids: stack.apply(params, ids))
+
+    buckets = (32, 64, 96)
+    worst = scenario.max_prompt_len + scenario.max_new_tokens
+    if scenario.max_prompt_len > max(buckets) or worst > 128:
+        raise SystemExit(
+            f"scenario {scenario.name} needs prompt<={max(buckets)} "
+            f"and {worst} positions but the bench engine tops out at "
+            f"128"
+        )
+    # paged KV so handoffs are page-aligned (the layout the export
+    # checksums cover stage by stage); page geometry identical in both
+    # pools — the record's geometry contract — while the decode
+    # specialist runs the deep slot ledger its role is tuned for
+    engine_kwargs = dict(num_slots=2, max_len=128, buckets=buckets,
+                         prefill_batch=1, kv_layout="paged",
+                         page_size=8)
+    decode_kwargs = dict(num_slots=4, num_pages=128)
+    if len(jax.devices()) < MONO_REPLICAS:
+        raise SystemExit(
+            f"bench needs {MONO_REPLICAS} devices for the equal-chips "
+            f"comparison, found {len(jax.devices())} (run under "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+        )
+
+    def supervisor():
+        # heartbeat/crash supervision stays on; the latency threshold
+        # is parked out of reach — a wall-clock sickness probe firing
+        # mid-replay would drain a replica INTO the latency
+        # measurement (the chaos bench is where supervision is the
+        # subject)
+        return FleetSupervisor(check_every=1, heartbeat_misses=1,
+                               sick_threshold=1e9, k_checks=3)
+
+    def make_fleet(mode):
+        if mode == "monolithic":
+            return ServingFleet(layer_cfgs, params,
+                                replicas=MONO_REPLICAS,
+                                engine_kwargs=dict(engine_kwargs),
+                                supervisor=supervisor())
+        return DisaggFleet(layer_cfgs, params,
+                           prefill_replicas=PREFILL_REPLICAS,
+                           decode_replicas=DECODE_REPLICAS,
+                           engine_kwargs=dict(engine_kwargs),
+                           decode_kwargs=dict(decode_kwargs),
+                           supervisor=supervisor())
+
+    def warm(fleet):
+        """Bucket warmup + counter reset: measured replays start from
+        a steady-state engine, and ``stats.compiles`` afterwards counts
+        exactly the steady-state recompiles the gate forbids."""
+        fleet.run([
+            Request(prompt=np.full((b - 2,), b + 1, np.int32),
+                    max_new_tokens=2)
+            for b in buckets for _ in range(2)
+        ])
+        fleet.reset_slo_windows()
+        for rep in fleet.replicas:
+            if rep.engine is not None:
+                rep.engine.stats.compiles = 0
+
+    def play(fleet):
+        def probe():
+            return dict(tick=fleet.tick,
+                        healthy=len(fleet.healthy_replicas),
+                        pending=fleet.stats.pending)
+
+        player = ScenarioPlayer(scenario, fleet, sample_fn=probe)
+        gc.collect()
+        gc.disable()
+        t0 = time.perf_counter()
+        try:
+            report = player.play()
+            # idle epilogue: in-flight handoffs deliver and decode
+            # rows drain inside the replay, as a production loop
+            # would keep ticking
+            for _ in range(int(epilogue)):
+                fleet.step()
+                report.timeline.append(probe())
+        finally:
+            gc.enable()
+        report.wall_s = time.perf_counter() - t0
+        return report
+
+    def compiles(fleet) -> int:
+        return sum(rep.engine.stats.compiles
+                   for rep in fleet.replicas if rep.engine is not None)
+
+    def handoff_counters(fleet):
+        keys = ("handoffs_out", "handoffs_in", "handoff_failures",
+                "handoff_bytes")
+        total = dict.fromkeys(keys, 0)
+        for rep in fleet.replicas:
+            if rep.engine is None:
+                continue
+            snap = rep.engine.stats.snapshot()
+            for k in keys:
+                total[k] += snap[k]
+        return total
+
+    def streams(report):
+        return [v.request.output().tolist() for v in report.finished]
+
+    # --- cache warmup: one throwaway replay per topology -----------------
+    # pays every process-global stage-program compile either operating
+    # point can demand, so all measured replays start cache-warm
+    print("warming the stage-program cache (throwaway replays)...",
+          flush=True)
+    warm_compiles = 0
+    for mode in ("monolithic", "disagg"):
+        throwaway = make_fleet(mode)
+        warm(throwaway)
+        play(throwaway)
+        warm_compiles += compiles(throwaway)
+    print(f"  cache warm ({warm_compiles} compiles absorbed)",
+          flush=True)
+
+    # --- measured replays: 4x each topology, INTERLEAVED -----------------
+    # host noise is strongly autocorrelated (load drifts over seconds),
+    # so alternating topologies makes both sample the same host epochs
+    # — a drift window cannot land on one topology's replays only
+    runs = {}
+    replays = {"monolithic": [], "disagg": []}
+    for i in range(REPLAYS):
+        for mode in ("monolithic", "disagg"):
+            fleet = make_fleet(mode)
+            warm(fleet)
+            print(f"running {scenario.name} [{mode} {i + 1}/"
+                  f"{REPLAYS}]...", flush=True)
+            report = play(fleet)
+            replays[mode].append((fleet, report))
+    for mode in ("monolithic", "disagg"):
+        per_run = []
+        for fleet, report in replays[mode]:
+            total = report.summary()["total"]
+            per_run.append(dict(
+                summary_total=total,
+                wall_s=round(report.wall_s, 3),
+                steady_state_compiles=compiles(fleet),
+            ))
+        fleet, report = replays[mode][0]
+        # min across same-seed replays = the noise floor: the schedule
+        # is deterministic, so inter-replay spread is host noise, and
+        # noise only ever ADDS wall time
+        doc = dict(
+            replays=per_run,
+            ttft_p95_s_floor=min(
+                r["summary_total"]["ttft_p95_s"] for r in per_run
+            ),
+            tpot_p95_s_floor=min(
+                r["summary_total"]["tpot_p95_s"] for r in per_run
+            ),
+            fleet_stats=fleet.stats.snapshot(),
+            handoff_counters=handoff_counters(fleet),
+        )
+        if mode == "disagg":
+            doc["ledger"] = fleet.ledger.audit()
+        runs[mode] = doc
+        t = per_run[0]["summary_total"]
+        print(f"  {mode}: finished {t['finished']}/{t['arrivals']}, "
+              f"ttft_p95 floor {doc['ttft_p95_s_floor']:.4f}s, "
+              f"tpot_p95 floor {doc['tpot_p95_s_floor']:.4f}s",
+              flush=True)
+
+    # --- verdicts --------------------------------------------------------
+    def identity_ok(report) -> bool:
+        for v in report.finished:
+            r = v.request
+            ref = generate(fwd, r.prompt[None],
+                           max_new_tokens=r.max_new_tokens,
+                           context_length=160)[0]
+            if not np.array_equal(r.output(), ref):
+                return False
+        return True
+
+    mono_fleet, mono_rep = replays["monolithic"][0]
+    dis_fleet, dis_rep = replays["disagg"][0]
+    ledger = runs["disagg"]["ledger"]
+
+    zero_lost = all(
+        len(report.finished) == len(report.admitted)
+        and fleet.stats.failed == 0
+        for mode in ("monolithic", "disagg")
+        for fleet, report in replays[mode]
+    )
+    # zero rejections -> both admitted lists follow the trace order, so
+    # stream k in one topology is stream k in the other
+    cross_identical = (
+        mono_fleet.stats.rejected == 0
+        and dis_fleet.stats.rejected == 0
+        and streams(mono_rep) == streams(dis_rep)
+    )
+    dis_reports = [rep for _, rep in replays["disagg"]]
+    dis_fleets = [fl for fl, _ in replays["disagg"]]
+    gates = dict(
+        ttft_p95_improved=bool(
+            runs["disagg"]["ttft_p95_s_floor"]
+            < runs["monolithic"]["ttft_p95_s_floor"]
+        ),
+        tpot_p95_improved=bool(
+            runs["disagg"]["tpot_p95_s_floor"]
+            < runs["monolithic"]["tpot_p95_s_floor"]
+        ),
+        zero_lost_tokens=bool(zero_lost),
+        token_identical=bool(
+            identity_ok(mono_rep) and identity_ok(dis_rep)
+            and cross_identical
+        ),
+        zero_steady_state_recompiles=bool(all(
+            r["steady_state_compiles"] == 0
+            for mode in ("monolithic", "disagg")
+            for r in runs[mode]["replays"]
+        )),
+        every_request_handed_off=bool(
+            ledger["delivered_total"] == len(dis_rep.finished)
+            and ledger["failed_total"] == 0
+        ),
+        ledger_conserved=bool(
+            ledger["conservation_ok"] and ledger["pending"] == 0
+        ),
+        workload_replayable=bool(mono_rep.digest == dis_rep.digest),
+        replay_deterministic=bool(
+            all(r.digest == dis_rep.digest for r in dis_reports)
+            and all(streams(r) == streams(dis_rep)
+                    for r in dis_reports)
+            and all(f.ledger.audit() == ledger for f in dis_fleets)
+        ),
+    )
+    passed = all(gates.values())
+
+    report_doc = dict(
+        bench="disagg_vs_monolithic",
+        device_kind=jax.devices()[0].device_kind,
+        model=dict(cfg.to_dict()),
+        fleet=dict(
+            chips_per_side=MONO_REPLICAS,
+            monolithic=dict(replicas=MONO_REPLICAS),
+            disagg=dict(prefill_replicas=PREFILL_REPLICAS,
+                        decode_replicas=DECODE_REPLICAS,
+                        decode_kwargs=decode_kwargs),
+            **engine_kwargs,
+        ),
+        scenario=scenario.to_dict(),
+        rate_scale=rate_scale,
+        epilogue_ticks=epilogue,
+        replays_per_topology=REPLAYS,
+        digest=dis_rep.digest,
+        warmup_compiles_absorbed=warm_compiles,
+        notes=(
+            "equal chips: 4 single-device monolithic replicas vs 3 "
+            "prefill + 1 deep-slab decode specialist on the same "
+            "trace; latency gates compare noise floors (min of 4 "
+            "per-replay p95s over INTERLEAVED gc-free replays — "
+            "same-seed replays are schedule-deterministic, so spread "
+            "is additive host noise, and alternating topologies makes "
+            "both sample the same host epochs); throwaway replays "
+            "pre-warm the process-global "
+            "stage-program cache, so zero steady-state compiles is a "
+            "both-topology fact and the handoff import path (the "
+            "engine's swap-in path) demonstrably adds no shapes of "
+            "its own"
+        ),
+        runs=runs,
+        gates=gates,
+        passed=passed,
+    )
+    if out:
+        with open(out, "w") as fh:
+            json.dump(report_doc, fh, indent=2)
+        print(f"# wrote {out}")
+    print(f"ledger: {ledger['delivered_total']} delivered / "
+          f"{ledger['failed_total']} failed / "
+          f"{ledger['pending']} pending")
+    print(f"gates: {gates}")
+    print(f"# {'PASS' if passed else 'FAIL'}")
+    return 0 if passed else 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--out", default=None,
+                        help="BENCH-style JSON artifact path")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--rate-scale", type=float, default=2.5,
+                        help="arrival-rate multiplier on disagg_mix "
+                             "(sized so the offered decode population "
+                             "fits the specialist's 16-row slab)")
+    parser.add_argument("--epilogue", type=int, default=60,
+                        help="idle fleet ticks after the trace drains "
+                             "(where in-flight handoffs deliver)")
+    args = parser.parse_args(argv)
+    return run_bench(args.out, args.seed, args.rate_scale,
+                     args.epilogue)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

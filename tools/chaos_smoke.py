@@ -99,8 +99,9 @@ def main() -> int:
     names = _cp.fault_plan_names()
     check(names == ["replica_crash_storm", "rolling_stragglers",
                     "mid_drain_kill", "swap_corruption",
-                    "reform_flap", "overload_then_crash"],
-          f"the six named plans are registered ({names})")
+                    "reform_flap", "overload_then_crash",
+                    "prefill_kill_mid_handoff"],
+          f"the seven named plans are registered ({names})")
     scenario_names = set(_wl.scenario_names())
     for name in names:
         p = _cp.get_fault_plan(name)

@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""CI smoke for the KV-handoff contract (pure stdlib).
+
+Loads ``disagg/handoff.py`` by file path (the skylint idiom, so the
+lint job exercises it on a bare runner, no jax/numpy installed) and
+drives the record/ledger contract end to end: every class of malformed
+:class:`HandoffRecord` rejected at construction, the ledger's strict
+state machine (``pending -> delivered``, ``pending|delivered ->
+failed``-with-reason, nothing else), duplicate-enqueue rejection,
+dead-source queries, and the conservation invariant the chaos auditor
+gates — every enqueued record in exactly one of {pending, delivered,
+failed-with-reason}, with a deterministic wall-clock-free event log.
+Drift in any of these silently un-conserves every in-flight handoff —
+this smoke is what makes the ledger's promise a CI fact instead of a
+docstring.
+
+Usage::
+
+    python tools/disagg_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+from tools._loader import load_module  # noqa: E402 - pure stdlib helper
+
+_ho = load_module("skycomputing_tpu.disagg.handoff",
+                  fallback_name="_skytpu_disagg_smoke")
+
+
+def check(cond, message):
+    if not cond:
+        print(f"FAIL: {message}")
+        raise SystemExit(1)
+    print(f"  ok: {message}")
+
+
+_HEX = "ab" * 32
+
+
+def record(rid=0, **over):
+    fields = dict(
+        request_id=rid, source="replica0", prompt_len=8,
+        prefilled_len=9, index=9, pages=2, checksum=_HEX,
+        slab_checksums=(_HEX, _HEX), page_size=8,
+        max_pages_per_request=4, stages=2, kv_dtype="float32", tick=3,
+    )
+    fields.update(over)
+    return _ho.HandoffRecord(**fields)
+
+
+def main() -> int:
+    print("record validation:")
+    r = record()
+    check(r.key() == record().key(),
+          "equal fields -> equal digest-stable key")
+    check(r.to_dict()["slab_checksums"] == [_HEX, _HEX],
+          "to_dict carries the verify_handoff_payload shape")
+    negatives = (
+        dict(request_id=-1),
+        dict(source=""),
+        dict(prompt_len=0),
+        dict(prefilled_len=7),          # below the prompt length
+        dict(pages=9),                  # over max_pages_per_request
+        dict(index=99),                 # pages cannot cover the index
+        dict(checksum="abc"),
+        dict(checksum=_HEX.upper()),
+        dict(slab_checksums=(_HEX,)),   # one digest per stage, or bust
+        dict(slab_checksums=[_HEX, _HEX]),  # tuple, not list
+        dict(kv_dtype=""),
+        dict(tick=-2),
+    )
+    for over in negatives:
+        try:
+            record(**over)
+        except ValueError:
+            pass
+        else:
+            check(False, f"malformed record must raise ({over})")
+    check(True, f"{len(negatives)} classes of malformed record "
+                f"rejected at construction")
+
+    print("ledger state machine:")
+    led = _ho.HandoffLedger()
+    try:
+        led.enqueue("not a record")
+    except ValueError:
+        check(True, "only HandoffRecord values enter the ledger")
+    else:
+        check(False, "non-record enqueue must raise")
+    led.enqueue(record(rid=1))
+    try:
+        led.enqueue(record(rid=1))
+    except ValueError:
+        check(True, "a request hands off at most once")
+    else:
+        check(False, "duplicate enqueue must raise")
+    check(led.state_of(1) == _ho.PENDING and led.state_of(99) is None,
+          "state_of: PENDING after enqueue, None for strangers")
+    try:
+        led.mark_failed(1, "")
+    except ValueError:
+        check(True, "a failure without a reason is refused")
+    else:
+        check(False, "empty failure reason must raise")
+    led.mark_delivered(1, target="replica2")
+    check(led.state_of(1) == _ho.DELIVERED, "pending -> delivered")
+    try:
+        led.mark_delivered(1)
+    except ValueError:
+        check(True, "delivered records cannot deliver twice")
+    else:
+        check(False, "double delivery must raise")
+    led.mark_failed(1, "checksum mismatch at import")
+    check(led.state_of(1) == _ho.FAILED,
+          "delivered -> failed stays legal (import verifies first, "
+          "discovers corruption after)")
+    try:
+        led.mark_failed(1, "again")
+    except ValueError:
+        check(True, "failed is final")
+    else:
+        check(False, "double failure must raise")
+    try:
+        led.mark_delivered(42)
+    except ValueError:
+        check(True, "moves on never-enqueued requests are refused")
+    else:
+        check(False, "unknown request move must raise")
+
+    print("conservation:")
+    led = _ho.HandoffLedger()
+    for rid, src in ((1, "replica0"), (2, "replica0"), (3, "replica1")):
+        led.enqueue(record(rid=rid, source=src))
+    led.mark_delivered(1, target="replica2")
+    led.mark_failed(2, "source died mid-handoff")
+    check([r.request_id for r in led.pending()] == [3],
+          "pending() lists PENDING records in enqueue order")
+    check([r.request_id for r in led.pending_for("replica1")] == [3]
+          and led.pending_for("replica0") == [],
+          "pending_for names a dead source's in-flight records")
+    audit = led.audit()
+    check(audit["conservation_ok"]
+          and audit["total"] == 3 and audit["pending"] == 1
+          and audit["delivered"] == 1 and audit["failed"] == 1,
+          "audit: every record in exactly one state")
+    check(audit["failed_reasons"] == {"source died mid-handoff": 1},
+          "every failure carries its reason into the audit")
+    snap = led.snapshot()
+    check(snap == dict(handoffs_enqueued=3, handoffs_delivered=1,
+                       handoffs_failed=1, handoffs_pending=1),
+          "snapshot: monotonic totals + the pending gauge")
+
+    print("replayability:")
+    def run():
+        led = _ho.HandoffLedger()
+        led.enqueue(record(rid=1))
+        led.enqueue(record(rid=2, source="replica1"))
+        led.mark_delivered(1, target="replica2")
+        led.mark_failed(2, "handoff record corrupted")
+        return led.events
+    check(run() == run(),
+          "same moves -> byte-identical event log (no wall clock)")
+
+    print("disagg smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

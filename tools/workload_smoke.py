@@ -89,8 +89,8 @@ def main() -> int:
     print("catalog:")
     names = _wl.scenario_names()
     check(names == ["diurnal_ramp", "flash_crowd", "tenant_mix",
-                    "rag_shared_prefix", "length_skew"],
-          f"the five named scenarios are registered ({names})")
+                    "rag_shared_prefix", "length_skew", "disagg_mix"],
+          f"the six named scenarios are registered ({names})")
     for name in names:
         sc = _wl.get_scenario(name)
         arrivals = sc.arrivals()
@@ -124,6 +124,17 @@ def main() -> int:
     check(lens[-1] >= 3 * lens[len(lens) // 2],
           "length_skew: the tail is genuinely heavy "
           f"(max {lens[-1]} vs median {lens[len(lens) // 2]})")
+    mix = _wl.get_scenario("disagg_mix")
+    phases = {p.name: p for p in mix.phases}
+    check(set(phases) == {"ingest_wave", "mixed", "chat_stream"},
+          "disagg_mix: ingest/mixed/chat phases present")
+    ingest, chat = phases["ingest_wave"], phases["chat_stream"]
+    check(ingest.prompt_len.max_value
+          > 2 * chat.prompt_len.max_value
+          and chat.new_tokens.max_value
+          > 2 * ingest.new_tokens.max_value,
+          "disagg_mix: the bottleneck genuinely flips between "
+          "prefill-bound and decode-bound phases")
 
     print("workload smoke: all checks passed")
     return 0
