@@ -232,6 +232,13 @@ MANIFEST: Dict[str, Any] = {
         "skycomputing_tpu.serving.paging",
         "skycomputing_tpu.telemetry.analysis",
         "skycomputing_tpu.telemetry.exporter",
+        # the flight-recorder ring + incident rule engine (the black
+        # box must render postmortems on a bare runner:
+        # tools/flight_smoke.py and tools/skyreport.py file-path-load
+        # both; the fleet taps live in fleet/fleet.py outside this
+        # contract)
+        "skycomputing_tpu.telemetry.flight",
+        "skycomputing_tpu.telemetry.incidents",
         "skycomputing_tpu.telemetry.metrics",
         "skycomputing_tpu.telemetry.slo",
         "skycomputing_tpu.telemetry.timeseries",
@@ -256,6 +263,9 @@ MANIFEST: Dict[str, Any] = {
         # fallback); the gated replay imports jax inside run_bench
         "tools.bench_chaos",
         "tools.bench_fleet",
+        # flight bench: entry is stdlib-only; the gated replay imports
+        # jax inside run_bench
+        "tools.bench_flight",
         # scenario bench: --list works on a bare runner (file-path
         # catalog fallback); the gated run imports jax inside run_bench
         "tools.bench_scenarios",
@@ -263,6 +273,7 @@ MANIFEST: Dict[str, Any] = {
         "tools.chaos_smoke",
         "tools.chunk_smoke",
         "tools.disagg_smoke",
+        "tools.flight_smoke",
         # mesh-shape-search contracts (file-path-loads dynamics/solver);
         # its jax section self-SKIPs on bare runners
         "tools.mesh_smoke",
@@ -277,6 +288,9 @@ MANIFEST: Dict[str, Any] = {
         "tools.skyaudit",
         "tools.skydet",
         "tools.skylint",
+        # postmortem renderer: file-path-loads the pure-stdlib incident
+        # core via tools/_loader, so bundles render on a bare runner
+        "tools.skyreport",
         "tools.trace_report",
         "tools.workload_smoke",
     ],
@@ -308,6 +322,8 @@ MANIFEST: Dict[str, Any] = {
     # constant key they produce must be classified there.
     "snapshot_contracts": {
         "EngineReplica.stats_snapshot": "EngineReplica",
+        "FlightRecorder.snapshot": "FlightRecorder",
+        "IncidentEngine.snapshot": "IncidentEngine",
         "ServingFleet._fleet_snapshot": "FleetStats",
     },
     # ---- determinism declarations (consumed by analysis/determinism.py,
@@ -320,6 +336,12 @@ MANIFEST: Dict[str, Any] = {
         "skycomputing_tpu.chaos.invariants",
         "skycomputing_tpu.chaos.plan",
         "skycomputing_tpu.dynamics.solver",
+        # the black box and its rule engine: deterministic logs /
+        # bundle digests must replay equal, so wall clocks enter only
+        # via the injected `clock=` (DET001) and every excluded field
+        # is declared in digest_excluded_fields below
+        "skycomputing_tpu.telemetry.flight",
+        "skycomputing_tpu.telemetry.incidents",
         "skycomputing_tpu.workload.scenario",
     ],
     # the replay cores whose contract is ONE `random.Random(seed)` in
@@ -339,9 +361,12 @@ MANIFEST: Dict[str, Any] = {
     # a digest touching them can never replay equal.  `resolved` is the
     # injector's load-based selector outcome — excluded from
     # deterministic_log for exactly this reason.
+    # (`score` is the supervisor's EWMA-of-wall-latency health score and
+    # `tick_s` the injected tick duration — both wall-derived, both
+    # excluded by the flight recorder's deterministic projection)
     "digest_excluded_fields": [
-        "req_id", "request_id", "resolved", "timestamp", "ts",
-        "wall_elapsed_s", "wall_s", "wall_time",
+        "req_id", "request_id", "resolved", "score", "tick_s",
+        "timestamp", "ts", "wall_elapsed_s", "wall_s", "wall_time",
     ],
     # helpers a digest folds whose names don't announce it — declared
     # here so DET003/DET004 walk them too (the `digest()` methods hash
@@ -351,6 +376,11 @@ MANIFEST: Dict[str, Any] = {
         "AuditCheck.to_dict",
         "AuditReport.to_dict",
         "FaultEvent.key",
+        # the flight/incident det projections: FlightRecorder.digest()
+        # and bundle_digest() hash exactly these outputs
+        "FlightEvent.det_dict",
+        "Incident.det_dict",
+        "deterministic_bundle_view",
     ],
     # the process-global program caches and their lookup gate: DET004
     # watches `id()`/`hash()` feeding their keys, DET005 proves every

@@ -233,6 +233,10 @@ class DisaggFleet(ServingFleet):
     def step(self) -> None:
         super().step()
         self._pump_handoffs()
+        # the pump runs after the base step's flight tap; drain the
+        # ledger transitions it just produced under the tick they
+        # happened on (super().step() already advanced self.tick)
+        self._flight_drain_ledger(self.tick - 1)
 
     def _pump_handoffs(self) -> None:
         """One pass of the handoff plane, after the fleet tick: deliver

@@ -42,7 +42,15 @@ import numpy as np
 
 from ..serving.batcher import FAILED, FINISHED, QueueFullError, REJECTED, Request
 from ..serving.engine import ServingEngine, ServingStats
-from ..telemetry import LiveMetricsMixin, MetricsRegistry, get_tracer
+from ..telemetry import (
+    FlightRecorder,
+    IncidentEngine,
+    LiveMetricsMixin,
+    MetricsRegistry,
+    SEV_CRITICAL,
+    build_bundle,
+    get_tracer,
+)
 from ..utils import Logger
 from ..utils.retry import retry_call
 from .admission import (
@@ -96,6 +104,9 @@ class FleetStats:
     # recovered from — the recovery arc's terminal counter
     faults_injected: int = 0
     recoveries_completed: int = 0
+    #: incident plane: anomalies the detector rules opened over the
+    #: fleet's own flight recorder (0 until ``attach_flight``)
+    incidents_opened: int = 0
     # gauges (last step)
     replicas_healthy: int = 0
     replicas_total: int = 0
@@ -110,6 +121,8 @@ class FleetStats:
     #: fleet reads high on it by design
     queue_depth: int = 0
     limbo_depth: int = 0
+    #: incidents currently open (gauge twin of ``incidents_opened``)
+    incidents_open: int = 0
 
     def count_rejection(self, reason: str) -> None:
         self.rejected += 1
@@ -133,10 +146,11 @@ class FleetStats:
         "scale_rejected": "counter",
         "faults_injected": "counter",
         "recoveries_completed": "counter",
+        "incidents_opened": "counter",
         "replicas_healthy": "gauge", "replicas_total": "gauge",
         "replicas_quarantined": "gauge",
         "pending": "gauge", "queue_depth": "gauge",
-        "limbo_depth": "gauge",
+        "limbo_depth": "gauge", "incidents_open": "gauge",
         "ttft_p50_s": "gauge", "ttft_p95_s": "gauge",
         "tpot_p50_s": "gauge", "tpot_p95_s": "gauge",
     }
@@ -159,12 +173,14 @@ class FleetStats:
             scale_rejected=self.scale_rejected,
             faults_injected=self.faults_injected,
             recoveries_completed=self.recoveries_completed,
+            incidents_opened=self.incidents_opened,
             replicas_healthy=self.replicas_healthy,
             replicas_total=self.replicas_total,
             replicas_quarantined=self.replicas_quarantined,
             pending=self.pending,
             queue_depth=self.queue_depth,
             limbo_depth=self.limbo_depth,
+            incidents_open=self.incidents_open,
         )
 
 
@@ -279,6 +295,15 @@ class ServingFleet(LiveMetricsMixin):
         self.timeseries = None
         self.slo = None
         self._exporter = None
+        # flight recorder + incident plane (opt-in via attach_flight;
+        # zero-cost until attached — one `is not None` test per step)
+        self.flight = None
+        self.incidents = None
+        self._flight_cursors: Dict[str, int] = {}
+        self._flight_engine_marks: Dict[str, Tuple[int, int]] = {}
+        self._slo_firing_prev: Tuple[str, ...] = ()
+        self._bundle_events = 256
+        self._bundles: deque = deque(maxlen=8)
         if slo is not None:
             self.attach_slo(slo)
         # the explicit admission bound was sized for THIS capacity;
@@ -346,6 +371,43 @@ class ServingFleet(LiveMetricsMixin):
         self.autoscaler = autoscaler
         return autoscaler
 
+    def attach_flight(self, recorder: Optional[FlightRecorder] = None,
+                      *, rules=None, quiet_ticks: int = 8,
+                      bundle_events: int = 256, max_bundles: int = 8):
+        """Wire the always-on flight recorder + incident plane into the
+        fleet loop.
+
+        ``step()`` then drains every subsystem's event surface into the
+        recorder once per tick (the sanctioned taps: supervisor,
+        autoscaler, fault injector, disagg ledger, SLO firing edges,
+        engine recompile/swap-corruption counters) and runs the
+        detector rules over it; a triggered rule opens an incident and
+        snapshots a postmortem bundle (:meth:`bundles`).  The incident
+        engine reads the fleet time-series, so one is enabled on
+        attach.
+        """
+        if self.flight is not None:
+            raise ValueError("a flight recorder is already attached")
+        recorder = recorder if recorder is not None else FlightRecorder()
+        self.flight = recorder
+        self._bundle_events = int(bundle_events)
+        self._bundles = deque(maxlen=max(int(max_bundles), 1))
+        self.metrics.register("flight", recorder.snapshot,
+                              types=type(recorder).FIELD_TYPES)
+        self.incidents = IncidentEngine(
+            recorder, self.enable_timeseries(), rules,
+            quiet_ticks=quiet_ticks,
+        )
+        self.metrics.register("incidents", self.incidents.snapshot,
+                              types=type(self.incidents).FIELD_TYPES)
+        return recorder
+
+    @property
+    def bundles(self) -> List[Dict[str, Any]]:
+        """The retained postmortem bundles, oldest first (bounded by
+        ``attach_flight``'s ``max_bundles``)."""
+        return list(self._bundles)
+
     def _health_snapshot(self) -> Dict[str, Any]:
         """The ``/healthz`` body: per-replica lifecycle states plus an
         overall verdict (``ok`` all healthy / ``degraded`` some /
@@ -354,6 +416,17 @@ class ServingFleet(LiveMetricsMixin):
         healthy = len(self.healthy_replicas)
         status = ("ok" if healthy == len(self.replicas)
                   else "degraded" if healthy else "down")
+        incidents_open: List[Dict[str, Any]] = []
+        if self.incidents is not None:
+            incidents_open = [i.to_dict()
+                              for i in self.incidents.open_incidents]
+            # an open critical incident caps the verdict: "every
+            # replica is up" is not "ok" while a detector says the
+            # fleet is corrupting counters or quarantining capacity
+            if status == "ok" and any(
+                i["severity"] == SEV_CRITICAL for i in incidents_open
+            ):
+                status = "degraded"
         return dict(
             status=status,
             tick=self.tick,
@@ -368,6 +441,7 @@ class ServingFleet(LiveMetricsMixin):
             pending=len(self._pending),
             limbo=len(self._limbo),
             slo_firing=list(self.slo.firing) if self.slo else [],
+            incidents_open=incidents_open,
         )
 
     # --- views --------------------------------------------------------------
@@ -887,7 +961,254 @@ class ServingFleet(LiveMetricsMixin):
             self.slo.evaluate(get_tracer())
         if self.autoscaler is not None:
             self.autoscaler.poll(self)
+        if self.flight is not None:
+            # the black box drains every subsystem's event surface
+            # AFTER the autoscaler, so this tick's whole story — fault,
+            # heal, scale, SLO verdict — is in the ring before the
+            # detector rules judge it
+            self._flight_tap()
+            self._incident_tick()
         self.tick += 1
+
+    # --- flight recorder taps (the sanctioned black-box feeds) --------------
+    #: supervisor event kind -> flight vocabulary
+    _SUPERVISOR_KINDS = {
+        "detect": "replica_detect",
+        "drain": "replica_drain",
+        "migrate": "replica_migrate",
+        "removed": "replica_removed",
+        "retired": "replica_retired",
+        "reform_failed": "reform_failed",
+        "reformed": "replica_reformed",
+    }
+    _AUTOSCALER_KINDS = ("scale_up", "scale_down", "scale_rejected")
+    _LEDGER_KINDS = {
+        "enqueue": "handoff_enqueued",
+        "deliver": "handoff_delivered",
+        "fail": "handoff_failed",
+    }
+    #: wall-microsecond width of the trace slice a bundle embeds
+    _bundle_trace_window_us = 2_000_000.0
+
+    def _drain_list(self, cursor_key: str, source: list) -> list:
+        """Cursor-drain a component's append-only event list: the tap
+        reads each entry exactly once, and components never know the
+        recorder exists."""
+        start = self._flight_cursors.get(cursor_key, 0)
+        fresh = source[start:]
+        self._flight_cursors[cursor_key] = len(source)
+        return fresh
+
+    def _flight_tap(self) -> None:
+        """Drain every subsystem's event surface into the flight
+        recorder (once per tick, end of ``step()``).  Components keep
+        their own append-only logs; this tap is the single sanctioned
+        feed, so the recorder stays pure stdlib and no subsystem grows
+        a recorder dependency."""
+        rec = self.flight
+        tick = self.tick
+        inj = self.fault_injector
+        if inj is not None:
+            applied = getattr(inj, "applied", None)
+            if applied is not None:
+                for e in self._drain_list("chaos.applied", applied):
+                    rec.record(
+                        int(e.get("tick", tick)), "chaos",
+                        "fault_applied" if e.get("ok")
+                        else "fault_skipped",
+                        subject=str(e.get("target") or ""), detail=e,
+                    )
+            recoveries = getattr(inj, "recoveries", None)
+            if recoveries is not None:
+                for e in self._drain_list("chaos.recoveries",
+                                          recoveries):
+                    rec.record(int(e.get("settled_tick", tick)),
+                               "chaos", "recovery_settled", detail=e)
+        for e in self._drain_list("supervisor", self.supervisor.events):
+            kind = self._SUPERVISOR_KINDS.get(e.get("kind"))
+            if kind is None:
+                continue
+            rec.record(int(e.get("tick", tick)), "supervisor", kind,
+                       subject=str(e.get("replica") or ""), detail=e)
+        if self.autoscaler is not None:
+            events = getattr(self.autoscaler, "events", None)
+            if events is not None:
+                for e in self._drain_list("autoscaler", events):
+                    kind = e.get("kind")
+                    if kind not in self._AUTOSCALER_KINDS:
+                        continue
+                    rec.record(
+                        int(e.get("tick", tick)), "autoscaler", kind,
+                        subject=str(e.get("replica")
+                                    or e.get("pool") or ""),
+                        detail=e,
+                    )
+        # serving lane: per-replica recompile / swap-corruption COUNTER
+        # DELTAS — the engine is never modified to push; the fleet (the
+        # only layer allowed to import both) reads the stats it already
+        # walks each tick
+        for replica in self.replicas:
+            engine = getattr(replica, "engine", None)
+            stats = getattr(engine, "stats", None)
+            if stats is None:
+                continue
+            compiles = int(getattr(stats, "compiles", 0))
+            corrupt = int(getattr(stats, "swap_corruptions", 0))
+            mark = self._flight_engine_marks.get(replica.name)
+            if mark is None or compiles < mark[0] or corrupt < mark[1]:
+                # first sight, or a re-formed engine reset its stats:
+                # re-baseline silently (re-form warmup compiles are the
+                # supervisor's story, not steady-state anomalies)
+                self._flight_engine_marks[replica.name] = (compiles,
+                                                           corrupt)
+                continue
+            if compiles > mark[0]:
+                rec.record(tick, "serving", "recompile",
+                           subject=replica.name,
+                           detail={"count": compiles - mark[0],
+                                   "total": compiles})
+            if corrupt > mark[1]:
+                rec.record(tick, "serving", "swap_corrupt",
+                           subject=replica.name,
+                           detail={"count": corrupt - mark[1],
+                                   "total": corrupt})
+            self._flight_engine_marks[replica.name] = (compiles,
+                                                       corrupt)
+        # slo lane: firing-set EDGES (alert raised / cleared), not the
+        # level — the recorder logs transitions, the timeseries holds
+        # the level
+        if self.slo is not None:
+            firing = tuple(self.slo.firing)
+            prev, now = set(self._slo_firing_prev), set(firing)
+            for target in sorted(now - prev):
+                rec.record(tick, "slo", "slo_alert", subject=target)
+            for target in sorted(prev - now):
+                rec.record(tick, "slo", "slo_clear", subject=target)
+            self._slo_firing_prev = firing
+        self._flight_drain_ledger(tick)
+
+    def _flight_drain_ledger(self, tick: int) -> None:
+        """Drain the disagg handoff ledger's event list (no-op on
+        monolithic fleets).  Split out of :meth:`_flight_tap` because
+        ``DisaggFleet`` pumps handoffs AFTER the base step — its pump
+        calls this again so same-tick ledger transitions land in the
+        ring under the tick they happened on."""
+        if self.flight is None:
+            return
+        events = getattr(getattr(self, "ledger", None), "events", None)
+        if events is None:
+            return
+        for e in self._drain_list("disagg", events):
+            kind = self._LEDGER_KINDS.get(e.get("kind"))
+            if kind is None:
+                continue
+            # which decode replica a handoff lands on is routing
+            # resolution (least-loaded / latency-scored — wall-state
+            # dependent by design), so it rides under the det-excluded
+            # "resolved" key: live views keep it, deterministic logs
+            # and bundle digests never see it
+            detail = dict(e)
+            resolved = {key: detail.pop(key)
+                        for key in ("source", "target")
+                        if key in detail}
+            if resolved:
+                detail["resolved"] = resolved
+            self.flight.record(
+                int(e.get("tick", tick)), "disagg", kind,
+                subject="", detail=detail,
+            )
+
+    def _incident_tick(self) -> None:
+        """Run the detector rules over this tick's recorded events;
+        every newly opened incident snapshots its postmortem bundle
+        HERE — at detection time, while the evidence is still in the
+        ring — not when someone asks for it later."""
+        engine = self.incidents
+        if engine is None:
+            return
+        opened, closed = engine.evaluate(self.tick)
+        tracer = get_tracer()
+        for inc in closed:
+            self.flight.record(
+                self.tick, "fleet", "incident_closed",
+                subject=inc.rule,
+                detail={"incident_id": inc.incident_id,
+                        "opened_tick": inc.opened_tick},
+            )
+            if tracer is not None:
+                tracer.instant(
+                    "incident_closed",
+                    tracer.lane("fleet", "incidents"),
+                    {"rule": inc.rule, "incident": inc.incident_id},
+                )
+        for inc in opened:
+            bundle = self._snapshot_incident_bundle(inc)
+            self._bundles.append(bundle)
+            self.flight.record(
+                self.tick, "fleet", "incident_opened",
+                subject=inc.rule,
+                detail={"incident_id": inc.incident_id,
+                        "severity": inc.severity,
+                        "bundle_digest": inc.bundle_digest},
+            )
+            if tracer is not None:
+                tracer.instant(
+                    "incident_opened",
+                    tracer.lane("fleet", "incidents"),
+                    {"rule": inc.rule, "incident": inc.incident_id,
+                     "severity": inc.severity},
+                )
+            self._logger.warning(
+                f"ServingFleet: incident {inc.incident_id} opened "
+                f"({inc.severity}): {inc.reason}"
+            )
+        # this is the engine's only evaluator, so the opened delta is
+        # exact and the stats counter stays monotone (AUD006)
+        self.stats.incidents_opened += len(opened)
+        self.stats.incidents_open = engine.open_count
+
+    def _topology_snapshot(self) -> Dict[str, Any]:
+        """Deterministic fleet shape: per-replica lifecycle + per-pool
+        (role) rollup — the 'what did the fleet look like' a bundle
+        stamps, and part of the bundle's digest-covered identity."""
+        replicas: Dict[str, Any] = {}
+        pools: Dict[str, Dict[str, int]] = {}
+        for r in self.replicas:
+            replicas[r.name] = dict(
+                state=r.state, role=r.role,
+                generation=int(getattr(r, "generation", 0)),
+                pending_removal=bool(getattr(r, "pending_removal",
+                                             False)),
+            )
+            pool = pools.setdefault(r.role or "default",
+                                    {"replicas": 0, "healthy": 0})
+            pool["replicas"] += 1
+            if r.state == HEALTHY and not r.crashed:
+                pool["healthy"] += 1
+        return dict(tick=self.tick, replicas=replicas, pools=pools)
+
+    def _snapshot_incident_bundle(self,
+                                  incident) -> Dict[str, Any]:
+        tracer = get_tracer()
+        trace_slice: List[Dict[str, Any]] = []
+        if tracer is not None:
+            since = max(0.0,
+                        tracer.now() - self._bundle_trace_window_us)
+            trace_slice = tracer.to_chrome(
+                since_us=since)["traceEvents"]
+        summary: Dict[str, Any] = {}
+        if self.timeseries is not None:
+            summary = self.timeseries.summary(points=16)
+        audit = getattr(getattr(self, "ledger", None), "audit", None)
+        return build_bundle(
+            incident, self.flight,
+            flight_events=self._bundle_events,
+            metrics_summary=summary,
+            trace_slice=trace_slice,
+            healthz=self._health_snapshot(),
+            topology=self._topology_snapshot(),
+            ledger_audit=audit() if callable(audit) else {},
+        )
 
     def _sweep_terminal(self) -> None:
         """Move finished requests to the fleet ledger's done side, and

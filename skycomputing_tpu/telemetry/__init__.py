@@ -16,12 +16,31 @@ wired in production code paths permanently (disabled tracing is a
   (Prometheus text), ``/metrics.json``, ``/healthz``;
 - :mod:`.slo` — declared SLO targets evaluated as multi-window burn
   rates, emitting ``slo_alert`` trace instants and a registry source;
+- :mod:`.flight` — the always-on flight recorder: one bounded ring of
+  structured events every subsystem's sanctioned tap feeds, with a
+  replay-deterministic log + digest;
+- :mod:`.incidents` — the incident plane: detector rules over the
+  recorder + time-series, postmortem bundles stamped with digests;
 - :mod:`.analysis` — trace analysis library (bubble/critical-path/
   serving breakdowns, per-request timeline reconstruction).
 """
 
 from . import analysis
 from .exporter import MetricsExporter
+from .flight import FLIGHT_KINDS, FLIGHT_LANES, FlightEvent, FlightRecorder
+from .incidents import (
+    Incident,
+    IncidentEngine,
+    SEV_CRITICAL,
+    SEV_INFO,
+    SEV_WARNING,
+    build_bundle,
+    bundle_digest,
+    cause_chain,
+    chain_stages,
+    default_rules,
+    deterministic_bundle_view,
+)
 from .live import LiveMetricsMixin
 from .metrics import MetricsRegistry
 from .slo import SloAlert, SloMonitor, SloTarget
@@ -36,14 +55,29 @@ from .tracer import (
 
 __all__ = [
     "analysis",
+    "FLIGHT_KINDS",
+    "FLIGHT_LANES",
+    "FlightEvent",
+    "FlightRecorder",
+    "Incident",
+    "IncidentEngine",
     "LiveMetricsMixin",
     "MetricsExporter",
     "MetricsRegistry",
     "MetricsTimeseries",
+    "SEV_CRITICAL",
+    "SEV_INFO",
+    "SEV_WARNING",
     "SloAlert",
     "SloMonitor",
     "SloTarget",
     "Tracer",
+    "build_bundle",
+    "bundle_digest",
+    "cause_chain",
+    "chain_stages",
+    "default_rules",
+    "deterministic_bundle_view",
     "disable_tracing",
     "enable_tracing",
     "get_tracer",

@@ -17,6 +17,9 @@ three endpoints from a background ``http.server`` thread:
 - ``GET /healthz`` — the wired subsystem's lifecycle view (fleet
   replica states, engine queue depth, runner progress) via an optional
   ``health`` callable; 200 with ``{"status": "ok"}`` by default.
+- ``GET /incidents`` — the incident plane's open + recently closed
+  incidents via an optional ``incidents`` callable; 200 with an empty
+  ledger by default, so scrapers can probe the route unconditionally.
 
 Cost contract: **zero when not started** — constructing an exporter
 binds nothing; ``start()`` binds the socket and spawns one daemon
@@ -172,7 +175,8 @@ class MetricsExporter:
     ``field_types()``); ``timeseries`` an optional
     :class:`~.timeseries.MetricsTimeseries` whose counter rates ride
     along on ``/metrics``; ``health`` a zero-arg callable returning the
-    ``/healthz`` dict.
+    ``/healthz`` dict; ``incidents`` a zero-arg callable returning the
+    ``/incidents`` dict (the incident engine's ``incidents_json``).
     """
 
     def __init__(
@@ -181,6 +185,7 @@ class MetricsExporter:
         *,
         timeseries: Any = None,
         health: Optional[Callable[[], Dict[str, Any]]] = None,
+        incidents: Optional[Callable[[], Dict[str, Any]]] = None,
         host: str = "127.0.0.1",
         port: int = 0,
         prefix: str = "skytpu",
@@ -188,6 +193,7 @@ class MetricsExporter:
         self._registry = registry
         self.timeseries = timeseries
         self._health = health
+        self._incidents = incidents
         self._host = str(host)
         self._port = int(port)
         self.prefix = str(prefix)
@@ -229,6 +235,14 @@ class MetricsExporter:
             return {"status": "ok"}
         got = self._health()
         return got if isinstance(got, dict) else {"status": str(got)}
+
+    def incidents_json(self) -> Dict[str, Any]:
+        if self._incidents is None:
+            return {"open": [], "closed": [],
+                    "opened_total": 0, "closed_total": 0}
+        got = self._incidents()
+        return got if isinstance(got, dict) else {"open": [],
+                                                  "closed": []}
 
     # --- lifecycle ----------------------------------------------------------
     @property
@@ -273,6 +287,12 @@ class MetricsExporter:
                 elif route == "/healthz":
                     render, ctype = (
                         lambda: json.dumps(exporter.health_json())
+                        .encode(),
+                        "application/json",
+                    )
+                elif route == "/incidents":
+                    render, ctype = (
+                        lambda: json.dumps(exporter.incidents_json())
                         .encode(),
                         "application/json",
                     )

@@ -55,15 +55,19 @@ class LiveMetricsMixin:
     def start_exporter(self, host: str = "127.0.0.1", port: int = 0):
         """Start (or return) the HTTP metrics endpoint — ``/metrics``
         (Prometheus text, with the time-series' counter rates when one
-        is enabled), ``/metrics.json``, and ``/healthz`` (the host's
-        ``_health_snapshot``).  Handler threads format registry
-        snapshots only — no jax, no host mutation."""
+        is enabled), ``/metrics.json``, ``/healthz`` (the host's
+        ``_health_snapshot``), and ``/incidents`` (the host's incident
+        engine when one is attached; an empty ledger otherwise).
+        Handler threads format registry snapshots only — no jax, no
+        host mutation."""
         if self._exporter is None:
             from .exporter import MetricsExporter
 
             self._exporter = MetricsExporter(
                 self.metrics, timeseries=self.timeseries,
-                health=self._health_snapshot, host=host, port=port,
+                health=self._health_snapshot,
+                incidents=self._incidents_json,
+                host=host, port=port,
             )
         else:
             self._exporter.timeseries = self.timeseries
@@ -78,6 +82,16 @@ class LiveMetricsMixin:
     def _health_snapshot(self) -> Dict[str, Any]:  # pragma: no cover
         """Hosts override with their lifecycle view."""
         return {"status": "ok"}
+
+    def _incidents_json(self) -> Dict[str, Any]:
+        """The ``/incidents`` body: hosts carrying an incident engine
+        (``self.incidents``, set by ``ServingFleet.attach_flight``)
+        serve its ledger; everyone else serves an empty one."""
+        engine = getattr(self, "incidents", None)
+        if engine is None:
+            return {"open": [], "closed": [],
+                    "opened_total": 0, "closed_total": 0}
+        return engine.incidents_json()
 
 
 __all__ = ["LiveMetricsMixin"]

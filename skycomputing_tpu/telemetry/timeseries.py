@@ -157,6 +157,12 @@ class MetricsTimeseries:
         with self._lock:
             return sorted(self._series)
 
+    def key_count(self) -> int:
+        """O(1) count of tracked keys — the cheap staleness check for
+        consumers caching a filtered key list (detector rules)."""
+        with self._lock:
+            return len(self._series)
+
     def series(self, key: str) -> List[Tuple[float, float]]:
         """(timestamp, value) pairs for a key, oldest first."""
         with self._lock:
@@ -165,15 +171,30 @@ class MetricsTimeseries:
     def values(self, key: str,
                window: Optional[int] = None) -> List[float]:
         """The newest ``window`` sampled values of a key (all when
-        ``window`` is None)."""
-        values = [v for _, v in self.series(key)]
-        if window is not None:
-            values = values[-int(window):]
-        return values
+        ``window`` is None).  O(window), not O(series): the incident
+        plane's detector rules call this every few ticks, so tail reads
+        must not copy the whole ring."""
+        with self._lock:
+            series = self._series.get(key)
+            if not series:
+                return []
+            if window is None or int(window) >= len(series):
+                return [v for _, v in series]
+            out = []
+            for point in reversed(series):
+                out.append(point[1])
+                if len(out) == int(window):
+                    break
+        out.reverse()
+        return out
 
     def latest(self, key: str) -> Optional[float]:
-        points = self.series(key)
-        return points[-1][1] if points else None
+        """The newest sampled value of a key — O(1), the hot read the
+        counter-monotonicity detector makes once per counter per
+        evaluation."""
+        with self._lock:
+            series = self._series.get(key)
+            return series[-1][1] if series else None
 
     def latest_sample(self) -> Dict[str, float]:
         """The most recent value of every key (one flat dict)."""

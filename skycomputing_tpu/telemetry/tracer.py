@@ -277,7 +277,11 @@ class Tracer:
         ``since_us`` exports only events with ``ts >= since_us`` (lane
         metadata always included) — the autotuner analyzes one window
         at a time, and filtering raw tuples here beats materializing
-        the full ring buffer just to discard most of it.
+        the full ring buffer just to discard most of it.  Async arcs
+        ("b"/"e") that BEGAN before the window but end inside it (or
+        are still open) get their begin re-synthesized at
+        ``ts=since_us`` with ``args.clipped=True``: a window must never
+        export a dangling ``e`` whose arc the viewer cannot open.
         """
         out: List[Dict[str, Any]] = []
         with self._lock:
@@ -296,7 +300,24 @@ class Tracer:
                             "args": {"sort_index": pid}})
             out.append({"ph": "M", "name": "thread_name", "ts": 0.0,
                         "pid": pid, "tid": tid, "args": {"name": thread}})
-        for ph, name, ts, dur, pid, tid, args, aid in list(self._events):
+        events = list(self._events)
+        if since_us is not None:
+            open_arcs: Dict[tuple, tuple] = {}
+            for ph, name, ts, dur, pid, tid, args, aid in events:
+                if ts >= since_us or ph not in ("b", "e"):
+                    continue
+                key = (name, pid, tid, aid)
+                if ph == "b":
+                    open_arcs[key] = (name, pid, tid, args, aid)
+                else:
+                    open_arcs.pop(key, None)
+            for name, pid, tid, args, aid in open_arcs.values():
+                out.append({
+                    "ph": "b", "name": name, "ts": float(since_us),
+                    "pid": pid, "tid": tid, "cat": "skytpu", "id": aid,
+                    "args": dict(args or {}, clipped=True),
+                })
+        for ph, name, ts, dur, pid, tid, args, aid in events:
             if since_us is not None and ts < since_us:
                 continue
             ev: Dict[str, Any] = {"ph": ph, "name": name, "ts": ts,

@@ -1,0 +1,576 @@
+"""Flight recorder & incident plane contracts (CPU-deterministic).
+
+The black box must be cheap enough to leave on, bounded so it cannot
+OOM the host, and deterministic where it claims to be: same-seed
+replays produce byte-identical deterministic logs and equal postmortem
+bundle digests, because the projection excludes wall times and
+request-routing resolution.  The incident plane must open incidents on
+real degradation (each detector rule's fire path), stay silent on
+healthy fleets (each rule's non-fire path), and snapshot a verifiable
+bundle at detection time.  The E2E test drives a scripted replica
+crash through a live fleet and asserts the whole story: tap -> detect
+-> bundle -> cause chain -> /healthz cap -> /incidents ledger.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from skycomputing_tpu.builder import build_layer_stack
+from skycomputing_tpu.chaos import FaultInjector
+from skycomputing_tpu.chaos.plan import REPLICA_CRASH, FaultEvent, FaultPlan
+from skycomputing_tpu.fleet import FleetSupervisor, ServingFleet
+from skycomputing_tpu.models.gpt import GptConfig, gpt_layer_configs
+from skycomputing_tpu.serving import Request
+from skycomputing_tpu.telemetry import (
+    FlightEvent,
+    FlightRecorder,
+    IncidentEngine,
+    SEV_CRITICAL,
+    Tracer,
+    build_bundle,
+    bundle_digest,
+    cause_chain,
+    chain_stages,
+)
+from skycomputing_tpu.telemetry.incidents import (
+    CounterRegressionRule,
+    HandoffFailureStreakRule,
+    QuarantineRule,
+    QueueDepthSpikeRule,
+    ReformBackoffEscalationRule,
+    ReplicaOutageRule,
+    RuleContext,
+    SloBurnRule,
+    SteadyStateRecompileRule,
+    default_rules,
+)
+from tools._loader import load_by_path
+
+pytestmark = pytest.mark.flight
+
+
+def ev(tick, lane, kind, subject="", **detail):
+    return FlightEvent(tick=tick, lane=lane, kind=kind,
+                       subject=subject, detail=detail)
+
+
+class FakeTS:
+    """Duck-typed MetricsTimeseries: just enough for the rules."""
+
+    def __init__(self, series):
+        self._series = {k: list(v) for k, v in series.items()}
+        self._types = {}
+
+    def classify(self, key, kind):
+        self._types[key] = kind
+        return self
+
+    def keys(self):
+        return sorted(self._series)
+
+    def key_count(self):
+        return len(self._series)
+
+    def type_of(self, key):
+        return self._types.get(key, "gauge")
+
+    def latest(self, key):
+        vals = self._series.get(key)
+        return vals[-1] if vals else None
+
+    def values(self, key, window=None):
+        vals = self._series.get(key, [])
+        return vals[-int(window):] if window is not None else list(vals)
+
+
+def ctx(tick, events=(), ts=None):
+    return RuleContext(tick, list(events), ts)
+
+
+# --------------------------------------------------------------------------
+# recorder: ring bounds, cursors, digest scoping
+# --------------------------------------------------------------------------
+
+
+def test_ring_bounds_and_eviction():
+    rec = FlightRecorder(capacity=3)
+    for i in range(5):
+        rec.record(i, "chaos", "fault_applied", subject=f"index:{i}")
+    assert len(rec) == 3
+    assert rec.recorded == rec.seq == 5
+    assert rec.evicted == 2
+    assert [e.tick for e in rec.events()] == [2, 3, 4]
+    assert [e.tick for e in rec.events(last=2)] == [3, 4]
+    # a lagging cursor resumes at the oldest survivor, never reorders
+    assert [e.tick for e in rec.events_since(0)] == [2, 3, 4]
+    assert [e.tick for e in rec.events_since(4)] == [4]
+    assert rec.events_since(7) == []
+    snap = rec.snapshot()
+    assert snap["flight_buffered"] == 3 and snap["flight_evicted"] == 2
+    with pytest.raises(ValueError):
+        FlightRecorder(capacity=0)
+
+
+def test_event_validation():
+    with pytest.raises(ValueError):
+        ev(-1, "fleet", "fault_applied")
+    with pytest.raises(ValueError):
+        ev(0, "nope", "fault_applied")
+    with pytest.raises(ValueError):
+        ev(0, "fleet", "nope")
+    with pytest.raises(TypeError):
+        ev(True, "fleet", "fault_applied")
+    with pytest.raises(TypeError):
+        FlightEvent(tick=0, lane="fleet", kind="fault_applied",
+                    subject=3)
+    with pytest.raises(TypeError):
+        FlightEvent(tick=0, lane="fleet", kind="fault_applied",
+                    detail={1: "x"})
+
+
+def test_digest_excludes_wall_and_routing():
+    def build(request_id, wall, target):
+        rec = FlightRecorder(clock=lambda: wall)
+        rec.record(4, "disagg", "handoff_failed",
+                   detail={"reason": "crash", "request_id": request_id,
+                           "resolved": {"target": target},
+                           "wall_s": wall})
+        return rec
+
+    a = build(11, 0.5, "replica1")
+    b = build(99, 9.5, "replica2")
+    assert a.digest() == b.digest()
+    assert a.deterministic_log() == b.deterministic_log()
+    assert "request_id" not in a.deterministic_log()[0]["detail"]
+    assert a.events()[0].wall_s == 0.5  # live view keeps the stamp
+    # content that IS identity-bearing changes the digest
+    c = FlightRecorder()
+    c.record(4, "disagg", "handoff_failed",
+             detail={"reason": "timeout"})
+    assert c.digest() != a.digest()
+
+
+# --------------------------------------------------------------------------
+# detector rules: fire AND non-fire paths
+# --------------------------------------------------------------------------
+
+
+def test_steady_state_recompile_rule():
+    rule = SteadyStateRecompileRule(warmup_ticks=10)
+    warm = ev(5, "serving", "recompile", subject="replica0", count=1)
+    assert rule.update(ctx(5, [warm])) is None          # warmup grace
+    assert rule.update(ctx(20, [])) is None             # quiet steady state
+    late = ev(20, "serving", "recompile", subject="replica0", count=1)
+    assert "replica0" in rule.update(ctx(20, [late]))   # fires
+
+
+def test_counter_regression_rule():
+    ts = FakeTS({"fleet.dispatched": [5.0, 7.0],
+                 "fleet.queue_depth": [9.0, 1.0]})
+    ts.classify("fleet.dispatched", "counter")  # gauge may move freely
+    rule = CounterRegressionRule()
+    assert rule.update(ctx(0, ts=ts)) is None           # monotonic: quiet
+    ts._series["fleet.dispatched"].append(3.0)
+    got = rule.update(ctx(4, ts=ts))
+    assert got is not None and "fleet.dispatched" in got
+
+
+def test_queue_depth_spike_rule():
+    rule = QueueDepthSpikeRule(factor=4.0, min_depth=24.0,
+                               baseline_window=32)
+    calm = FakeTS({"fleet.queue_depth": [2.0, 3.0, 2.0, 2.0, 30.0]})
+    # 30 >= 24 floor and >= 4 x median(2): fires
+    assert rule.update(ctx(10, ts=calm)) is not None
+    shallow = FakeTS({"fleet.queue_depth": [2.0, 3.0, 2.0, 2.0, 11.0]})
+    assert rule.update(ctx(10, ts=shallow)) is None     # under the floor
+    busy = FakeTS({"fleet.queue_depth": [20.0, 25.0, 22.0, 21.0, 26.0]})
+    assert rule.update(ctx(10, ts=busy)) is None        # own baseline
+    assert rule.update(ctx(10, ts=FakeTS({}))) is None  # no history
+
+
+def test_quarantine_rule():
+    rule = QuarantineRule()
+    assert rule.update(ctx(5, [])) is None
+    healing = ev(5, "supervisor", "reform_failed", subject="replica1",
+                 retired=False)
+    assert rule.update(ctx(5, [healing])) is None       # still healing
+    retired = ev(6, "supervisor", "replica_retired", subject="replica1")
+    assert "replica1" in rule.update(ctx(6, [retired]))
+
+
+def test_handoff_failure_streak_rule():
+    rule = HandoffFailureStreakRule(threshold=2, window_ticks=40)
+    one = ev(10, "disagg", "handoff_failed", reason="checksum")
+    assert rule.update(ctx(10, [one])) is None          # one-off fallback
+    two = ev(30, "disagg", "handoff_failed", reason="checksum")
+    assert rule.update(ctx(30, [two])) is not None      # streak in window
+    # the window slides: old failures age out, streak dissolves
+    rule2 = HandoffFailureStreakRule(threshold=2, window_ticks=40)
+    rule2.update(ctx(10, [one]))
+    far = ev(60, "disagg", "handoff_failed", reason="checksum")
+    assert rule2.update(ctx(60, [far])) is None
+
+
+def test_slo_burn_rule():
+    rule = SloBurnRule(streak_ticks=5)
+    assert rule.update(ctx(0, ts=FakeTS({}))) is None
+    flap = FakeTS({"slo.firing_streak": [0.0, 3.0]})
+    assert rule.update(ctx(0, ts=flap)) is None         # flap filter
+    burn = FakeTS({"slo.firing_streak": [4.0, 5.0]})
+    assert rule.update(ctx(0, ts=burn)) is not None
+
+
+def test_reform_backoff_escalation_rule():
+    rule = ReformBackoffEscalationRule(failures=2)
+    f1 = ev(5, "supervisor", "reform_failed", subject="replica0",
+            backoff=1.0)
+    assert rule.update(ctx(5, [f1])) is None            # first strike
+    healed = ev(6, "supervisor", "replica_reformed", subject="replica0")
+    assert rule.update(ctx(6, [healed])) is None        # success resets
+    f2 = ev(7, "supervisor", "reform_failed", subject="replica0",
+            backoff=1.0)
+    f3 = ev(8, "supervisor", "reform_failed", subject="replica0",
+            backoff=2.0)
+    got = rule.update(ctx(8, [f2, f3]))
+    assert got is not None and "replica0" in got
+
+
+def test_replica_outage_rule():
+    rule = ReplicaOutageRule()
+    lat = ev(5, "supervisor", "replica_detect", subject="replica0",
+             reason="latency")
+    assert rule.update(ctx(5, [lat])) is None   # wall-derived: excluded
+    dead = ev(6, "supervisor", "replica_detect", subject="replica0",
+              reason="dead")
+    got = rule.update(ctx(6, [dead]))
+    assert got is not None and "dead" in got
+
+
+# --------------------------------------------------------------------------
+# incident engine lifecycle
+# --------------------------------------------------------------------------
+
+
+def test_engine_open_quiet_close_and_feedback_isolation():
+    rec = FlightRecorder()
+    engine = IncidentEngine(rec, rules=default_rules(), quiet_ticks=3)
+    assert engine.evaluate(0) == ([], [])
+    rec.record(5, "supervisor", "replica_detect", subject="replica0",
+               detail={"reason": "dead"})
+    opened, _ = engine.evaluate(5)
+    assert [i.rule for i in opened] == ["replica_outage"]
+    assert opened[0].severity == SEV_CRITICAL and opened[0].open
+    assert engine.worst_open_severity() == SEV_CRITICAL
+    # the engine's own lifecycle events must never feed detection
+    rec.record(5, "fleet", "incident_opened", subject="replica_outage")
+    _, closed = engine.evaluate(6)
+    assert engine.open_count == 1 and not closed
+    _, closed = engine.evaluate(7)
+    assert not closed                       # quiet window still running
+    _, closed = engine.evaluate(8)
+    assert [i.incident_id for i in closed] \
+        == [opened[0].incident_id]
+    assert closed[0].closed_tick == 8 and not closed[0].open
+    ledger = engine.incidents_json()
+    assert ledger["opened_total"] == ledger["closed_total"] == 1
+    assert ledger["open"] == [] and len(ledger["closed"]) == 1
+    snap = engine.snapshot()
+    assert snap["incidents_opened"] == 1 and snap["incidents_open"] == 0
+
+
+def test_engine_rule_cadence_is_tick_arithmetic():
+    ts = FakeTS({"fleet.done": [5.0, 3.0]}).classify("fleet.done",
+                                                     "counter")
+    rec = FlightRecorder()
+    engine = IncidentEngine(rec, timeseries=ts,
+                            rules=[CounterRegressionRule()])
+    engine.evaluate(4)                      # baselines 3.0 on-cadence
+    ts._series["fleet.done"].append(1.0)
+    assert engine.evaluate(5) == ([], [])   # off-cadence: not evaluated
+    opened, _ = engine.evaluate(8)          # next multiple of every=4
+    assert [i.rule for i in opened] == ["counter_regression"]
+
+
+def test_engine_one_open_incident_per_rule():
+    rec = FlightRecorder()
+    engine = IncidentEngine(rec, rules=default_rules(), quiet_ticks=8)
+    for tick in (3, 4):
+        rec.record(tick, "supervisor", "replica_detect",
+                   subject=f"replica{tick}", detail={"reason": "dead"})
+        engine.evaluate(tick)
+    assert engine.opened_total == 1 and engine.open_count == 1
+    assert engine.open_incidents[0].last_fire_tick == 4
+
+
+# --------------------------------------------------------------------------
+# bundles: digest determinism, tamper evidence, cause chain
+# --------------------------------------------------------------------------
+
+
+def _storyline(rec):
+    rec.record(10, "chaos", "fault_applied", subject="index:0",
+               detail={"kind": "replica_crash", "resolved": "replica0"})
+    rec.record(11, "supervisor", "replica_detect", subject="replica0",
+               detail={"reason": "dead"})
+    rec.record(12, "supervisor", "replica_migrate", subject="replica0")
+    rec.record(18, "chaos", "recovery_settled",
+               detail={"fault_tick": 10, "settled_tick": 18})
+    return rec
+
+
+def _bundle(wall=None):
+    from skycomputing_tpu.telemetry.incidents import Incident
+
+    clock = (lambda: wall) if wall is not None else None
+    rec = _storyline(FlightRecorder(clock=clock))
+    incident = Incident("replica_outage-t000011-n0001",
+                        "replica_outage", SEV_CRITICAL, 11,
+                        "replica outage: replica0 (dead)")
+    return build_bundle(
+        incident, rec,
+        metrics_summary={"wall_noise": wall},
+        trace_slice=[{"ph": "i", "ts": wall or 0.0}],
+        healthz={"status": "degraded"},
+        topology={"tick": 11, "replicas": {"replica0":
+                                           {"state": "forming"}}},
+    ), incident
+
+
+def test_bundle_digest_deterministic_across_double_runs():
+    b1, i1 = _bundle(wall=1.25)
+    b2, i2 = _bundle(wall=99.0)   # different wall clock, same story
+    assert b1["digest"] == b2["digest"]
+    assert i1.bundle_digest == b1["digest"]
+    assert bundle_digest(b1) == b1["digest"]
+    # metrics/trace are outside the identity by design...
+    assert b1["metrics"] != b2["metrics"]
+    # ...but the digest-covered subset is tamper-evident
+    tampered = dict(b1, incident=dict(b1["incident"], reason="edited"))
+    assert bundle_digest(tampered) != b1["digest"]
+    # and a JSON round-trip (what skyreport loads) verifies cleanly
+    assert bundle_digest(json.loads(json.dumps(b1))) == b1["digest"]
+
+
+def test_cause_chain_stages_and_anchor():
+    events = _storyline(FlightRecorder()).events()
+    chain = cause_chain(events)
+    assert chain_stages(chain) == ["fault", "impact", "remediation",
+                                   "settled"]
+    assert [c["kind"] for c in chain] == [
+        "fault_applied", "replica_detect", "replica_migrate",
+        "recovery_settled"]
+    # pre-fault noise is excluded: the chain anchors at the fault
+    rec = FlightRecorder()
+    rec.record(2, "supervisor", "replica_drain", subject="replica9")
+    _storyline(rec)
+    assert cause_chain(rec.events())[0]["kind"] == "fault_applied"
+    # det-dict (bundle JSON) and live-event forms chain identically
+    assert cause_chain([e.det_dict() for e in events]) == chain
+
+
+# --------------------------------------------------------------------------
+# tracer windowing pin (async arcs spanning the window edge)
+# --------------------------------------------------------------------------
+
+
+def test_to_chrome_window_clips_open_async_arcs():
+    t = {"now": 0.0}
+    tracer = Tracer(clock=lambda: t["now"])
+    lane = tracer.lane("fleet", "heal")
+    tracer.async_begin("reform", lane, 7, {"replica": "r0"})
+    t["now"] = 10e-6
+    tracer.async_begin("short", lane, 8)
+    t["now"] = 20e-6
+    tracer.async_end("short", lane, 8)     # closed before the window
+    t["now"] = 50e-6
+    tracer.async_end("reform", lane, 7)    # closes inside the window
+    out = tracer.to_chrome(since_us=30.0)["traceEvents"]
+    arcs = [e for e in out if e.get("ph") in ("b", "e")]
+    begins = [e for e in arcs if e["ph"] == "b"]
+    ends = [e for e in arcs if e["ph"] == "e"]
+    # the still-open arc is re-begun at the window edge, marked clipped
+    assert [e["name"] for e in begins] == ["reform"]
+    assert begins[0]["args"].get("clipped") is True
+    assert begins[0]["ts"] == pytest.approx(30.0)
+    assert begins[0]["id"] == 7
+    # its end pairs up; the fully-pre-window arc is not resurrected
+    assert [e["name"] for e in ends] == ["reform"]
+    assert not [e for e in arcs if e["name"] == "short"]
+
+
+# --------------------------------------------------------------------------
+# fleet E2E: tap -> detect -> bundle -> healthz -> /incidents
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    cfg = GptConfig(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=2, max_position_embeddings=64,
+                    dropout_prob=0.0, dtype="float32")
+    layer_cfgs = gpt_layer_configs(cfg, deterministic=True)
+    stack = build_layer_stack(layer_cfgs)
+    params = stack.init(jax.random.key(7), np.ones((1, 5), np.int32))
+    return layer_cfgs, params
+
+
+def _crash_plan():
+    return FaultPlan(
+        name="flight_e2e", seed=0, scenario="tenant_mix",
+        recovery_budget_ticks=12,
+        events=(FaultEvent(tick=3, kind=REPLICA_CRASH,
+                           target="index:0"),),
+    )
+
+
+def test_fleet_incident_e2e_cause_chain(gpt):
+    layer_cfgs, params = gpt
+    fleet = ServingFleet(
+        layer_cfgs, params, replicas=2,
+        engine_kwargs=dict(num_slots=2, max_len=48, buckets=(16, 32)),
+        supervisor=FleetSupervisor(check_every=1, heartbeat_misses=1,
+                                   sick_threshold=1e9, k_checks=2),
+    )
+    fleet.attach_flight(quiet_ticks=6)
+    fleet.fault_injector = FaultInjector(_crash_plan())
+    rng = np.random.default_rng(0)
+    for _ in range(3):
+        fleet.submit(Request(
+            prompt=rng.integers(1, 512, (6,)).astype(np.int32),
+            max_new_tokens=4))
+    opened_at = None
+    for _ in range(20):
+        fleet.step()
+        if opened_at is None and fleet.incidents.opened_total:
+            opened_at = fleet.tick
+            # an open critical incident caps /healthz at degraded
+            health = fleet._health_snapshot()
+            assert health["status"] == "degraded"
+            assert health["incidents_open"][0]["rule"] \
+                == "replica_outage"
+    assert opened_at is not None, "crash never opened an incident"
+    assert fleet.stats.incidents_opened >= 1
+    bundles = fleet.bundles
+    assert bundles and bundles[0]["incident"]["rule"] == "replica_outage"
+    assert bundles[0]["digest"] == bundle_digest(bundles[0])
+    stages = chain_stages(cause_chain(bundles[0]["flight_log"]))
+    assert stages[0] == "fault" and "impact" in stages
+    assert bundles[0]["topology"]["replicas"]  # shape is stamped
+    ledger = fleet._incidents_json()
+    assert ledger["opened_total"] == fleet.incidents.opened_total
+    # flight counters ride the metrics registry (AUD005 discipline)
+    snap = fleet.metrics.snapshot()
+    assert snap["flight"]["flight_recorded"] == fleet.flight.recorded
+    assert snap["incidents"]["incidents_opened"] \
+        == fleet.incidents.opened_total
+
+
+def test_recorder_off_is_zero_cost(gpt, monkeypatch):
+    layer_cfgs, params = gpt
+    fleet = ServingFleet(
+        layer_cfgs, params, replicas=1,
+        engine_kwargs=dict(num_slots=2, max_len=48, buckets=(16, 32)),
+    )
+    assert fleet.flight is None and fleet.incidents is None
+
+    def boom(*a, **k):  # the disabled path must never reach the taps
+        raise AssertionError("flight path entered with recorder off")
+
+    monkeypatch.setattr(ServingFleet, "_flight_tap", boom)
+    monkeypatch.setattr(ServingFleet, "_incident_tick", boom)
+    for _ in range(3):
+        fleet.step()
+    health = fleet._health_snapshot()
+    assert health["status"] == "ok"
+    assert health["incidents_open"] == []
+    assert fleet._incidents_json()["open"] == []
+
+
+def test_attach_flight_twice_raises(gpt):
+    layer_cfgs, params = gpt
+    fleet = ServingFleet(
+        layer_cfgs, params, replicas=1,
+        engine_kwargs=dict(num_slots=2, max_len=48, buckets=(16, 32)),
+    )
+    fleet.attach_flight()
+    with pytest.raises(ValueError):
+        fleet.attach_flight()
+
+
+# --------------------------------------------------------------------------
+# skyreport CLI (file-path loaded, exit codes)
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def skyreport():
+    return load_by_path("_test_skyreport", "tools", "skyreport.py")
+
+
+def _write_bundle(tmp_path, mutate=None):
+    bundle, _ = _bundle(wall=0.5)
+    if mutate:
+        mutate(bundle)
+    path = tmp_path / "bundle.json"
+    path.write_text(json.dumps(bundle))
+    return str(path)
+
+
+def test_skyreport_renders_and_verifies(skyreport, tmp_path, capsys):
+    path = _write_bundle(tmp_path)
+    assert skyreport.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "Postmortem: replica_outage-t000011-n0001" in out
+    assert "fault -> impact -> remediation -> settled" in out
+    assert "(verified)" in out
+    assert skyreport.main([path, "--format=json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["digest_verified"] is True
+    assert report["stages"] == ["fault", "impact", "remediation",
+                                "settled"]
+    assert set(report["lanes"]) == {"chaos", "supervisor"}
+
+
+def test_skyreport_exit_codes(skyreport, tmp_path, capsys):
+    assert skyreport.main([str(tmp_path / "missing.json")]) == 1
+    bad = tmp_path / "bad.json"
+    bad.write_text("[1, 2]")
+    assert skyreport.main([str(bad)]) == 1
+    schema = _write_bundle(
+        tmp_path, mutate=lambda b: b.update(schema="other-v0"))
+    assert skyreport.main([schema]) == 1
+    tampered = _write_bundle(
+        tmp_path,
+        mutate=lambda b: b["incident"].update(reason="edited"))
+    assert skyreport.main([tampered]) == 1   # renders, then flags
+    assert "DIGEST MISMATCH" in capsys.readouterr().out
+
+
+def test_trace_report_incident_overlay(tmp_path, capsys):
+    trace_report = load_by_path("_test_trace_report", "tools",
+                                "trace_report.py")
+    t = {"now": 0.0}
+    tracer = Tracer(clock=lambda: t["now"])
+    # analyze() needs a stage lane; the incident instant rides its own
+    stage = tracer.lane("stage 0 [cpu]", "dispatch")
+    t["now"] = 1e-3
+    tracer.complete("fwd", stage, 0.0)
+    tracer.instant("incident_opened", tracer.lane("fleet", "incidents"),
+                   {"rule": "replica_outage", "incident": "i-1"})
+    trace = tmp_path / "trace.json"
+    trace.write_text(json.dumps(tracer.to_chrome()))
+    bundle = _write_bundle(tmp_path)
+    rc = trace_report.main([str(trace), "--incidents", bundle,
+                            "--json"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    marks = report["incidents"]["marks"]
+    assert [m["name"] for m in marks] == ["incident_opened"]
+    assert report["incidents"]["incident"]["rule"] == "replica_outage"
+    # unreadable bundle is a clean CLI error, not a traceback
+    assert trace_report.main(
+        [str(trace), "--incidents", str(tmp_path / "nope.json")]) == 1

@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""CI smoke for the flight-recorder / incident core (pure stdlib).
+
+Loads ``telemetry/flight.py`` and ``telemetry/incidents.py`` by file
+path (the skylint idiom — the lint job runs this on a bare runner, no
+jax/numpy installed) and drives the black-box contract end to end:
+build-time validation of lanes/kinds/ticks, ring bounds and cursor
+semantics, detector-rule fire AND non-fire paths, and the digest
+discipline — stable across re-projection, insensitive to the excluded
+wall/routing fields, sensitive to actual event content.  Drift in any
+of these silently changes every committed postmortem bundle — this
+smoke is what makes "same seed, same black box, forever" a CI fact.
+
+Usage::
+
+    python tools/flight_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+from tools._loader import load_module  # noqa: E402 - pure stdlib helper
+
+_fl = load_module("skycomputing_tpu.telemetry.flight",
+                  fallback_name="_skytpu_flight_smoke")
+_inc = load_module("skycomputing_tpu.telemetry.incidents",
+                   fallback_name="_skytpu_flight_smoke_inc")
+
+
+def check(cond, message):
+    if not cond:
+        print(f"FAIL: {message}")
+        raise SystemExit(1)
+    print(f"  ok: {message}")
+
+
+def main() -> int:
+    FlightEvent = _fl.FlightEvent
+    FlightRecorder = _fl.FlightRecorder
+
+    print("event validation:")
+    for bad, exc_type in (
+        (lambda: FlightEvent(tick=-1, lane="fleet",
+                             kind="fault_applied"), ValueError),
+        (lambda: FlightEvent(tick=True, lane="fleet",
+                             kind="fault_applied"), TypeError),
+        (lambda: FlightEvent(tick=0, lane="backplane",
+                             kind="fault_applied"), ValueError),
+        (lambda: FlightEvent(tick=0, lane="fleet",
+                             kind="meteor_strike"), ValueError),
+        (lambda: FlightEvent(tick=0, lane="fleet", kind="fault_applied",
+                             subject=7), TypeError),
+        (lambda: FlightEvent(tick=0, lane="fleet", kind="fault_applied",
+                             detail=[1]), TypeError),
+        (lambda: FlightEvent(tick=0, lane="fleet", kind="fault_applied",
+                             detail={1: "x"}), TypeError),
+    ):
+        try:
+            bad()
+        except exc_type:
+            pass
+        else:
+            check(False, "malformed events must raise at build time")
+    check(True, "malformed ticks/lanes/kinds/subjects/details rejected")
+
+    print("ring + cursor:")
+    rec = FlightRecorder(capacity=4)
+    for i in range(6):
+        rec.record(i, "chaos", "fault_applied", subject=f"index:{i}")
+    check(len(rec) == 4 and rec.recorded == 6 and rec.evicted == 2,
+          "ring keeps newest capacity events and counts evictions")
+    check([e.tick for e in rec.events()] == [2, 3, 4, 5],
+          "oldest events evicted first")
+    check([e.tick for e in rec.events_since(4)] == [4, 5],
+          "cursor resumes at the requested sequence")
+    check([e.tick for e in rec.events_since(0)] == [2, 3, 4, 5],
+          "a cursor lagged past eviction resumes at oldest survivor")
+    check(rec.events_since(99) == [],
+          "a future cursor sees nothing")
+
+    print("digest discipline:")
+    a, b = FlightRecorder(), FlightRecorder()
+    a.record(3, "disagg", "handoff_failed", subject="prefill-0",
+             detail={"reason": "crash", "request_id": 101,
+                     "wall_s": 0.25})
+    b.record(3, "disagg", "handoff_failed", subject="prefill-0",
+             detail={"reason": "crash", "request_id": 9999,
+                     "wall_s": 7.5})
+    check(a.digest() == b.digest(),
+          "request ids and wall times stay out of the digest")
+    check(a.digest() == a.digest(), "digest is stable")
+    c = FlightRecorder()
+    c.record(3, "disagg", "handoff_failed", subject="prefill-0",
+             detail={"reason": "timeout", "request_id": 101})
+    check(a.digest() != c.digest(),
+          "actual event content changes the digest")
+    check(a.deterministic_log() == b.deterministic_log(),
+          "deterministic logs are byte-identical modulo excluded keys")
+
+    print("rule fire / non-fire:")
+    engine_rec = FlightRecorder()
+    engine = _inc.IncidentEngine(engine_rec, rules=_inc.default_rules(),
+                                 quiet_ticks=2)
+    opened, closed = engine.evaluate(0)
+    check(not opened and not closed,
+          "an empty tick opens nothing (non-fire path)")
+    engine_rec.record(5, "supervisor", "replica_detect",
+                      subject="replica-1", detail={"reason": "dead"})
+    opened, _ = engine.evaluate(5)
+    check(len(opened) == 1 and opened[0].rule == "replica_outage"
+          and opened[0].severity == _inc.SEV_CRITICAL,
+          "a dead-replica detect opens a critical replica_outage")
+    engine_rec.record(6, "supervisor", "replica_detect",
+                      subject="replica-2", detail={"reason": "latency"})
+    opened2, closed2 = engine.evaluate(6)
+    check(not opened2,
+          "wall-derived latency detects never open incidents")
+    check(not closed2 and engine.open_count == 1,
+          "incident stays open inside the quiet window")
+    _, closed = engine.evaluate(7)
+    check(len(closed) == 1 and closed[0].closed_tick == 7,
+          "quiet_ticks without a fire closes the incident")
+    check(engine.open_count == 0 and engine.closed_total == 1,
+          "engine counters track the lifecycle")
+
+    print("bundle + cause chain:")
+    story = FlightRecorder()
+    story.record(10, "chaos", "fault_applied", subject="index:0",
+                 detail={"kind": "replica_crash"})
+    story.record(11, "supervisor", "replica_detect", subject="replica-0",
+                 detail={"reason": "dead"})
+    story.record(12, "supervisor", "replica_migrate",
+                 subject="replica-0")
+    story.record(20, "chaos", "recovery_settled", subject="index:0")
+    chain = _inc.cause_chain(story.events())
+    check(_inc.chain_stages(chain)
+          == ["fault", "impact", "remediation", "settled"],
+          "the cause chain reads fault -> impact -> remediation "
+          "-> settled")
+    incident = _inc.Incident("smoke-t000011-n0001", "replica_outage",
+                             _inc.SEV_CRITICAL, 11, "replica-0 dead")
+    bundle = _inc.build_bundle(incident, story)
+    check(bundle["digest"] == _inc.bundle_digest(bundle)
+          and incident.bundle_digest == bundle["digest"],
+          "bundles are stamped with their own verifiable digest")
+    chain2 = _inc.cause_chain(bundle["flight_log"])
+    check(chain2 == chain,
+          "the chain reconstructs identically from the JSON bundle")
+    story2 = FlightRecorder()
+    for e in story.events():
+        story2.record(e.tick, e.lane, e.kind, e.subject, dict(e.detail))
+    incident2 = _inc.Incident("smoke-t000011-n0001", "replica_outage",
+                              _inc.SEV_CRITICAL, 11, "replica-0 dead")
+    bundle2 = _inc.build_bundle(incident2, story2)
+    check(bundle2["digest"] == bundle["digest"],
+          "an identical replay produces an equal bundle digest")
+
+    print("flight smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

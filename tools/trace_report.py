@@ -24,6 +24,13 @@ the machine-readable schema the tuner, CI, and external dashboards all
 consume; with ``--baseline`` the gate verdict rides along under a
 ``baseline_gate`` key.
 
+``--incidents BUNDLE`` overlays a postmortem bundle (the incident
+plane's ``tools/skyreport.py`` artifact) on the report: the bundle's
+incident identity plus every ``incident_opened`` / ``incident_closed``
+instant found in the analyzed trace, time-ordered — so a trace and its
+postmortem read as one artifact.  With ``--json`` the overlay rides
+along under an ``incidents`` key.
+
 ``--smoke`` runs the full analysis on the checked-in fixture trace
 (``tools/fixtures/trace_smoke.json``) and fails on any structural
 drift — the CI lint job runs it so this tool cannot silently rot.
@@ -153,6 +160,52 @@ def _print_human(report: Dict[str, Any]) -> None:
           f"{report['transfers']['elided']} elided")
 
 
+def _incident_overlay(events: List[Dict[str, Any]],
+                      bundle_path: str) -> Dict[str, Any]:
+    """The ``--incidents`` overlay: the bundle's incident identity plus
+    every incident-lifecycle instant present in the analyzed trace."""
+    with open(bundle_path) as fh:
+        bundle = json.load(fh)
+    if not isinstance(bundle, dict):
+        raise json.JSONDecodeError("bundle is not an object",
+                                   bundle_path, 0)
+    marks = [
+        {"name": ev.get("name"),
+         "ts_ms": float(ev.get("ts", 0.0)) / 1000.0,
+         "args": ev.get("args") or {}}
+        for ev in events
+        if ev.get("ph") == "i"
+        and ev.get("name") in ("incident_opened", "incident_closed")
+    ]
+    marks.sort(key=lambda m: m["ts_ms"])
+    return {
+        "bundle": bundle_path,
+        "schema": bundle.get("schema"),
+        "incident": bundle.get("incident") or {},
+        "digest": bundle.get("digest"),
+        "marks": marks,
+    }
+
+
+def _print_incidents(overlay: Dict[str, Any]) -> None:
+    inc = overlay["incident"]
+    closed = inc.get("closed_tick")
+    print(f"# incident {inc.get('incident_id', '?')} "
+          f"[{inc.get('severity', '?')}] rule={inc.get('rule', '?')} "
+          f"opened@tick {inc.get('opened_tick', '?')}"
+          + (f" closed@tick {closed}" if closed is not None
+             else " (still open)"))
+    if inc.get("reason"):
+        print(f"#   reason: {inc['reason']}")
+    if overlay.get("digest"):
+        print(f"#   bundle digest: {overlay['digest']}")
+    if not overlay["marks"]:
+        print("#   (no incident instants in this trace window)")
+    for m in overlay["marks"]:
+        args = {k: v for k, v in m["args"].items()}
+        print(f"#   {m['ts_ms']:10.3f} ms {m['name']:<16} {args}")
+
+
 def _run_request_mode(path: str, args) -> int:
     """``--request ID``: the per-request waterfall path (no aggregate
     analysis — a request-only trace has no stage lanes to analyze)."""
@@ -233,6 +286,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "waterfall (queue/admission/prefill/"
                              "decode/migration segments) instead of "
                              "the aggregate report")
+    parser.add_argument("--incidents", metavar="BUNDLE",
+                        help="postmortem bundle JSON (skyreport "
+                             "artifact) to overlay: incident identity "
+                             "+ open/close instants on the timeline")
     args = parser.parse_args(argv)
 
     path = args.trace
@@ -246,11 +303,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _run_request_mode(path, args)
 
     try:
-        report = analyze(load_events(path))
+        events = load_events(path)
+        report = analyze(events)
     except (OSError, json.JSONDecodeError, TraceError, KeyError) as exc:
         print(f"trace_report: cannot analyze {path}: {exc}",
               file=sys.stderr)
         return 1
+
+    overlay: Optional[Dict[str, Any]] = None
+    if args.incidents:
+        try:
+            overlay = _incident_overlay(events, args.incidents)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"trace_report: cannot read incident bundle "
+                  f"{args.incidents}: {exc}", file=sys.stderr)
+            return 1
 
     failures: Optional[List[str]] = None
     if args.baseline:
@@ -275,9 +342,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "failures": failures,
                 "ok": not failures,
             })
+        if overlay is not None:
+            report = dict(report, incidents=overlay)
         print(json.dumps(report), flush=True)
     else:
         _print_human(report)
+        if overlay is not None:
+            _print_incidents(overlay)
 
     if args.smoke:
         # structural self-check: the fixture encodes a 2-stage pipeline
